@@ -1,0 +1,36 @@
+"""adapt_tpu — TPU-native adaptive pipeline-parallel inference framework.
+
+A ground-up re-design of ADAPT (reference:
+``Karthi-es/Adaptive-Deep-Learning-Architecture-for-Parallel-and-Fault-Tolerant-Inference``)
+for TPU hardware:
+
+- models are declared as a DAG of named JAX/flax layers (``adapt_tpu.graph``),
+  replacing Keras runtime-graph introspection (reference ``src/dag_util.py``);
+- pipeline stages are XLA-compiled functions placed on devices of a
+  ``jax.sharding.Mesh`` (``adapt_tpu.core``), replacing per-worker TF slice
+  executors (reference ``src/node.py``);
+- activations hop between stages over ICI (device-to-device transfers /
+  ``ppermute``), with an optional quantizing codec only where a DCN/host
+  boundary is crossed (``adapt_tpu.comm``), replacing lz4+zfp over raw TCP
+  (reference ``src/node_state.py:39-161``, ``src/dispatcher.py:92-98``);
+- a host-side control plane provides TTL-lease membership, late stage->worker
+  binding, an in-flight registry with replayable payloads and a deadline
+  watchdog (``adapt_tpu.control``), the reconstructed Gen-2 design of the
+  reference dispatcher (``src/dispatcher.py:121-317``);
+- SPMD parallelism (pipeline, data, tensor, sequence/ring-attention) lives in
+  ``adapt_tpu.parallel`` as ``shard_map``/``pjit`` programs over a device mesh.
+"""
+
+__version__ = "0.1.0"
+
+from adapt_tpu.graph.ir import INPUT, LayerGraph
+from adapt_tpu.graph.partition import PartitionPlan, partition, valid_cut_points
+
+__all__ = [
+    "INPUT",
+    "LayerGraph",
+    "PartitionPlan",
+    "partition",
+    "valid_cut_points",
+    "__version__",
+]

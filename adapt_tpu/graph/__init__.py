@@ -1,0 +1,19 @@
+from adapt_tpu.graph.ir import INPUT, LayerGraph, LayerNode
+from adapt_tpu.graph.partition import (
+    InvalidCutError,
+    PartitionPlan,
+    StageSpec,
+    partition,
+    valid_cut_points,
+)
+
+__all__ = [
+    "INPUT",
+    "LayerGraph",
+    "LayerNode",
+    "InvalidCutError",
+    "PartitionPlan",
+    "StageSpec",
+    "partition",
+    "valid_cut_points",
+]

@@ -1,0 +1,202 @@
+"""Layer-graph IR: models as a DAG of named flax modules.
+
+The reference slices Keras models by introspecting the framework's runtime
+graph (``/root/reference/src/dag_util.py:3-62`` walks ``inbound_nodes``
+backward from a named layer, memoizing rebuilt tensors so DAG joins are
+rebuilt once). JAX has no such runtime graph, so here the graph is *declared*:
+a model is a DAG of named nodes, each wrapping a flax module (or any pure
+``apply(variables, *inputs)`` pair). Named nodes give the partitioner stable
+cut points — the same capability the reference gets from Keras layer names —
+without depending on tracer internals, and each stage lowers to one XLA
+program (the Python topo-order loop unrolls at trace time).
+
+Design notes (TPU-first):
+- Node granularity is "block-ish" (a residual branch, a merge, a transformer
+  block), keeping graphs small (tens of nodes) so per-stage jit traces fast
+  and XLA sees large fusable regions.
+- Multi-input nodes (residual ``add``, ``concat``) are first-class: a node's
+  ``inputs`` tuple names its predecessors, exactly the DAG-join case the
+  reference handles at ``src/dag_util.py:28-33``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Callable, Mapping, Sequence
+from typing import Any
+
+import jax
+
+#: Sentinel name for the graph's input tensor (the reference's analog is the
+#: output tensor of the ``start`` layer fed to ``tf.keras.Input`` at
+#: ``src/dag_util.py:52``).
+INPUT = "__input__"
+
+Variables = Mapping[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerNode:
+    """One named node of the model DAG.
+
+    ``module`` is any object with flax's ``init(rng, *inputs)`` /
+    ``apply(variables, *inputs)`` protocol. ``inputs`` names predecessor
+    nodes (or :data:`INPUT`).
+    """
+
+    name: str
+    module: Any
+    inputs: tuple[str, ...]
+
+    def apply(self, variables: Variables, *args: jax.Array) -> jax.Array:
+        return self.module.apply(variables, *args)
+
+
+class LayerGraph:
+    """A DAG of named layers with a single input and a single output node.
+
+    Nodes must be added in topological order (every input must already
+    exist), which makes insertion order a valid execution order — the same
+    invariant Keras maintains for its layer list, relied on by the
+    reference's partitioner (``src/dispatcher.py:39-53``).
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._nodes: dict[str, LayerNode] = {}
+        self._output: str | None = None
+
+    # -- construction -------------------------------------------------------
+
+    def add(
+        self,
+        name: str,
+        module: Any,
+        inputs: str | Sequence[str] = INPUT,
+    ) -> str:
+        """Add a named node; returns the name so calls can be chained."""
+        if isinstance(inputs, str):
+            inputs = (inputs,)
+        inputs = tuple(inputs)
+        if name in self._nodes or name == INPUT:
+            raise ValueError(f"duplicate layer name: {name!r}")
+        for dep in inputs:
+            if dep != INPUT and dep not in self._nodes:
+                raise ValueError(
+                    f"layer {name!r} depends on unknown layer {dep!r} "
+                    "(nodes must be added in topological order)"
+                )
+        self._nodes[name] = LayerNode(name=name, module=module, inputs=inputs)
+        self._output = name
+        return name
+
+    def set_output(self, name: str) -> None:
+        if name not in self._nodes:
+            raise ValueError(f"unknown layer: {name!r}")
+        self._output = name
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def output(self) -> str:
+        if self._output is None:
+            raise ValueError("empty graph")
+        return self._output
+
+    @property
+    def nodes(self) -> Mapping[str, LayerNode]:
+        return self._nodes
+
+    def node(self, name: str) -> LayerNode:
+        try:
+            return self._nodes[name]
+        except KeyError:
+            raise KeyError(
+                f"no layer {name!r} in graph {self.name!r}; "
+                f"known layers: {list(self._nodes)[:8]}..."
+            ) from None
+
+    def topo_order(self) -> list[str]:
+        return list(self._nodes)
+
+    def consumers(self, name: str) -> list[str]:
+        return [n.name for n in self._nodes.values() if name in n.inputs]
+
+    # -- execution ----------------------------------------------------------
+
+    def init(self, rng: jax.Array, x: jax.Array) -> dict[str, Variables]:
+        """Initialize every node by running a forward pass in topo order.
+
+        Returns ``{node_name: flax variables}``. BatchNorm-style collections
+        (``batch_stats``) are kept inside each node's variables; inference
+        runs them in eval mode so ``apply`` stays pure.
+        """
+        variables: dict[str, Variables] = {}
+        cache: dict[str, jax.Array] = {INPUT: x}
+        for node in self._nodes.values():
+            rng, sub = jax.random.split(rng)
+            args = [cache[dep] for dep in node.inputs]
+            variables[node.name] = node.module.init(sub, *args)
+            cache[node.name] = node.module.apply(variables[node.name], *args)
+        return variables
+
+    def apply(
+        self, variables: Mapping[str, Variables], x: jax.Array
+    ) -> jax.Array:
+        """Run the full graph (un-partitioned); the single-device path."""
+        return self.apply_subset(variables, self.topo_order(), {INPUT: x})
+
+    def apply_subset(
+        self,
+        variables: Mapping[str, Variables],
+        node_names: Sequence[str],
+        boundary: Mapping[str, jax.Array],
+        output: str | None = None,
+    ) -> jax.Array:
+        """Execute ``node_names`` (a topo-ordered subset) given boundary
+        tensors; the primitive that stage ``apply`` functions build on."""
+        cache: dict[str, jax.Array] = dict(boundary)
+        for name in node_names:
+            node = self._nodes[name]
+            args = [cache[dep] for dep in node.inputs]
+            cache[name] = node.apply(variables[name], *args)
+        return cache[output if output is not None else node_names[-1]]
+
+    def eval_shapes(
+        self, variables: Mapping[str, Variables], x: jax.ShapeDtypeStruct
+    ) -> dict[str, jax.ShapeDtypeStruct]:
+        """Shape-propagate the graph without running it: per-node output
+        shapes, used by the planner to size activation buffers/codecs."""
+        shapes: dict[str, jax.ShapeDtypeStruct] = {INPUT: x}
+        for node in self._nodes.values():
+            args = [shapes[dep] for dep in node.inputs]
+            fn = lambda *a, _n=node: _n.apply(variables[_n.name], *a)
+            shapes[node.name] = jax.eval_shape(fn, *args)
+        del shapes[INPUT]
+        return shapes
+
+    def __repr__(self) -> str:
+        return (
+            f"LayerGraph({self.name!r}, nodes={len(self._nodes)}, "
+            f"output={self._output!r})"
+        )
+
+
+class Lambda:
+    """Wrap a pure parameterless function as a node module (merge ops like
+    residual add, concat — the reference's Keras ``Add``/``Concatenate``)."""
+
+    def __init__(self, fn: Callable[..., jax.Array], name: str = "lambda"):
+        self._fn = fn
+        self.name = name
+
+    def init(self, rng: jax.Array, *args: jax.Array) -> Variables:
+        del rng, args
+        return {}
+
+    def apply(self, variables: Variables, *args: jax.Array) -> jax.Array:
+        del variables
+        return self._fn(*args)
+
+    def __repr__(self) -> str:
+        return f"Lambda({self.name})"

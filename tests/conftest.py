@@ -1,0 +1,35 @@
+"""Test bootstrap: simulated 8-device CPU mesh.
+
+The reference's only "multi-node without a cluster" affordance is localhost
+aliasing (``/root/reference/src/dispatcher.py:163-173``). Our analog is a
+virtual device mesh: force the JAX CPU backend to expose 8 devices so every
+multi-stage / multi-worker / fault-injection test runs hermetically in CI
+with real (host) transfers between real XLA devices.
+
+Must run before jax initializes a backend, hence env vars at import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
