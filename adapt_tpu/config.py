@@ -1,0 +1,58 @@
+"""Typed configuration.
+
+The reference has no config system — every knob is a source-code constant
+(ports ``src/dispatcher.py:14-17``, chunk size ``:24``, worker list / cut
+layers / image path hand-edited per README:43-48). Framework-owned upgrade:
+one frozen dataclass per subsystem, assembled into ``ServeConfig``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """Timeouts and retry policy (reference analogs cited per field)."""
+
+    # Worker lease TTL; reference: etcd lease on /workers/<ip> (node_state.py:20).
+    lease_ttl_s: float = 2.0
+    # Heartbeat period (must be < lease_ttl_s).
+    heartbeat_s: float = 0.5
+    # Per-task deadline before the watchdog re-dispatches; reference:
+    # _task_watchdog scanning inflight start_time (dispatcher.py:302-304).
+    # Must exceed worst-case first-compile time unless the pipeline is
+    # warmed up first (ServingPipeline.warmup) — first XLA compiles on TPU
+    # can take tens of seconds.
+    task_deadline_s: float = 60.0
+    # Watchdog scan period.
+    watchdog_period_s: float = 0.25
+    # Startup wait for the first worker; reference: 5 s bounded wait then
+    # clean shutdown (dispatcher.py:282-295).
+    startup_wait_s: float = 5.0
+    # Max re-dispatch attempts per task before failing the request.
+    max_retries: int = 3
+    # Worker-configuration handshake timeout; reference: connect 5 s /
+    # ACK 60 s (dispatcher.py:226,250-260).
+    configure_timeout_s: float = 60.0
+
+
+@dataclasses.dataclass(frozen=True)
+class CodecConfig:
+    """Activation codec at host/DCN boundaries (reference compresses every
+    hop with zfp+lz4, dispatcher.py:92-98; on TPU, ICI hops need none)."""
+
+    name: str = "none"  # none | bf16 | int8 | zfp
+    # zfp-style fixed tolerance (absolute) when name == "zfp".
+    tolerance: float = 1e-3
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Top-level serving configuration."""
+
+    # Bounded request concurrency; reference: concurrency semaphore
+    # (dispatcher.py:151,183) and queue.Queue(10) (test/test.py:40).
+    max_inflight: int = 8
+    fault: FaultConfig = dataclasses.field(default_factory=FaultConfig)
+    codec: CodecConfig = dataclasses.field(default_factory=CodecConfig)

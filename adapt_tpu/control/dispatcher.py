@@ -1,0 +1,469 @@
+"""Adaptive dispatcher: the Gen-2 hub-and-spoke control loop, completed.
+
+This is the working re-expression of the reference's *intended* design —
+the five lost methods of ``/root/reference/src/dispatcher.py`` rebuilt on a
+device mesh (SURVEY.md §0, §2.6-2.7):
+
+- ``_worker_monitor``       -> registry watch callbacks (:276)
+- ``_get_available_workers``-> ``WorkerRegistry.alive()`` (:285)
+- ``_intermediate_result_server`` -> ``_result_loop`` draining the result
+  queue every worker posts to (:298; fragment :121-161)
+- ``_task_watchdog``        -> ``_watchdog_loop`` over the in-flight
+  registry (:303)
+- ``_acquire_and_configure_worker`` -> ``_acquire`` + lazy
+  ``StageWorker.configure`` (:178; config handshake :223-264)
+
+Semantics beyond the reference (SURVEY.md §7.4): requests carry ids and
+attempt counters, so watchdog re-dispatch plus a late-completing original
+worker cannot duplicate or drop a request (the reference could do both).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+
+from adapt_tpu.config import ServeConfig
+from adapt_tpu.control.registry import WorkerRegistry
+from adapt_tpu.control.worker import StageWorker, Task, TaskResult, WorkerState
+from adapt_tpu.graph.partition import PartitionPlan
+from adapt_tpu.utils.logging import get_logger
+from adapt_tpu.utils.metrics import global_metrics
+
+log = get_logger("dispatcher")
+
+
+class RequestFailed(RuntimeError):
+    """A request exhausted its retries (or no workers remain)."""
+
+
+class PipelineFuture:
+    """Completion handle for one submitted request."""
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: str | None = None
+
+    def _complete(self, value: Any = None, error: str | None = None) -> bool:
+        if self._event.is_set():
+            return False  # exactly-once: late duplicates dropped
+        self._value, self._error = value, error
+        self._event.set()
+        return True
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not done within {timeout}s"
+            )
+        if self._error is not None:
+            raise RequestFailed(self._error)
+        return self._value
+
+
+@dataclass
+class _Inflight:
+    """Reference in-flight entry: ``{(worker_ip, partition_idx):
+    {partition, data, start_time}}`` with the raw payload retained for
+    re-send (``src/dispatcher.py:186-194``) — keyed here by request id,
+    extended with attempt/retry counters for exactly-once."""
+
+    request_id: int
+    stage_index: int
+    attempt: int
+    payload: Any
+    worker_id: str
+    start_time: float
+    retries: int = 0
+    future: PipelineFuture = field(default=None)  # type: ignore[assignment]
+
+
+class Dispatcher:
+    """Hub dispatcher over in-process stage workers."""
+
+    def __init__(
+        self,
+        plan: PartitionPlan,
+        variables,
+        registry: WorkerRegistry | None = None,
+        config: ServeConfig | None = None,
+    ):
+        self.plan = plan
+        self.config = config or ServeConfig()
+        self.registry = registry or WorkerRegistry(
+            default_ttl_s=self.config.fault.lease_ttl_s
+        )
+        # One shared jitted fn per stage: jit caches executables per device,
+        # so configuring the same stage on another same-kind device reuses
+        # the compiled program (recovery = weight move, not recompile).
+        self._stage_fns = [
+            jax.jit(plan.stage_apply(spec)) for spec in plan.stages
+        ]
+        self._stage_host_vars = plan.extract_variables(variables)
+        self._workers: dict[str, StageWorker] = {}
+        self._workers_lock = threading.Lock()
+        self.result_queue: queue.Queue[TaskResult] = queue.Queue()
+        self._inflight: dict[int, _Inflight] = {}
+        self._inflight_lock = threading.Lock()
+        self._sem = threading.Semaphore(self.config.max_inflight)
+        self._req_ids = itertools.count()
+        self._watchdog_paused = False
+        self._shutdown = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._started = False
+
+    # -- worker pool --------------------------------------------------------
+
+    def spawn_workers(self, devices) -> list[StageWorker]:
+        """One in-process worker per device (single-host mode: TPU chips as
+        the reference's 'machines' — its localhost mode, SURVEY.md §4)."""
+        workers = []
+        for i, dev in enumerate(devices):
+            w = StageWorker(
+                worker_id=f"worker-{i}",
+                device=dev,
+                registry=self.registry,
+                result_queue=self.result_queue,
+                fault=self.config.fault,
+            )
+            self.attach_worker(w)
+            workers.append(w)
+        return workers
+
+    def attach_worker(self, worker: StageWorker) -> None:
+        with self._workers_lock:
+            self._workers[worker.worker_id] = worker
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Dispatcher":
+        if self._started:
+            return self
+        self.registry.start()
+        with self._workers_lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            w.start()
+        if not self.registry.wait_for_workers(
+            1, self.config.fault.startup_wait_s
+        ):
+            # Reference: clean shutdown when no worker appears in 5 s
+            # (src/dispatcher.py:290-295).
+            self.shutdown()
+            raise RequestFailed(
+                f"no workers registered within "
+                f"{self.config.fault.startup_wait_s}s"
+            )
+        self.registry.watch(self._on_membership)
+        for name, target in (
+            ("results", self._result_loop),
+            ("watchdog", self._watchdog_loop),
+        ):
+            t = threading.Thread(
+                target=target, name=f"dispatcher-{name}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        self._started = True
+        return self
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self.result_queue.put(None)  # type: ignore[arg-type]
+        for t in self._threads:
+            t.join(timeout=2.0)
+        # Fail outstanding futures promptly instead of letting callers
+        # sleep out their timeouts.
+        with self._inflight_lock:
+            abandoned = list(self._inflight.values())
+            self._inflight.clear()
+        for e in abandoned:
+            self._finish(e.future, error="dispatcher shut down")
+        with self._workers_lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            w.stop()
+        self.registry.stop()
+
+    # -- request API --------------------------------------------------------
+
+    def submit(self, x) -> PipelineFuture:
+        """Enqueue one request into the pipeline (reference input pump,
+        ``src/dispatcher.py:99-107``); bounded by the concurrency
+        semaphore (``:151,183``)."""
+        if self._shutdown.is_set():
+            raise RequestFailed("dispatcher is shut down")
+        self._sem.acquire()
+        request_id = next(self._req_ids)
+        future = PipelineFuture(request_id)
+        try:
+            self._dispatch(request_id, 0, x, future, attempt=0, retries=0)
+        except Exception as e:  # no worker at all -> fail fast
+            self._finish(future, error=str(e))
+        return future
+
+    def infer(self, x, timeout: float | None = 60.0) -> Any:
+        return self.submit(x).result(timeout)
+
+    def warmup(self, example, timeout: float | None = 300.0) -> None:
+        """Run one request end-to-end with the watchdog paused, so
+        first-compile time (tens of seconds on TPU) is paid here instead of
+        triggering spurious re-dispatches in serving."""
+        self._watchdog_paused = True
+        try:
+            self.infer(example, timeout)
+        finally:
+            self._watchdog_paused = False
+
+    def serve_stream(self, inputs, timeout_per_request: float = 120.0):
+        """Pump a stream through the pipeline, preserving order (reference
+        driver semantics, ``test/test.py:48-50``)."""
+        futures = [self.submit(x) for x in inputs]
+        return [f.result(timeout_per_request) for f in futures]
+
+    def metrics_snapshot(self) -> dict:
+        return global_metrics().snapshot()
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _acquire(self, stage_index: int, exclude: set[str]) -> StageWorker:
+        """Late binding: pick a live worker for this stage *now* (reference
+        ``_acquire_and_configure_worker``, call site
+        ``src/dispatcher.py:178``). Preference: already-configured idle >
+        idle > shallowest queue; excluded (suspect) workers only as a last
+        resort."""
+        alive = set(self.registry.alive())
+        with self._workers_lock:
+            pool = [
+                w
+                for wid, w in self._workers.items()
+                if wid in alive and w.state is not WorkerState.DEAD
+            ]
+        if not pool:
+            raise RequestFailed("no live workers")
+        candidates = [w for w in pool if w.worker_id not in exclude] or pool
+
+        def rank(w: StageWorker):
+            return (
+                0 if w.is_configured(stage_index) else 1,
+                0 if w.state is WorkerState.IDLE else 1,
+                w.queue_depth,
+            )
+
+        last_error: Exception | None = None
+        for worker in sorted(candidates, key=rank):
+            if worker.is_configured(stage_index):
+                return worker
+            try:
+                self._configure_with_timeout(worker, stage_index)
+                return worker
+            except Exception as e:  # noqa: BLE001 — try the next candidate
+                log.warning(
+                    "configure of stage %d on %s failed: %s",
+                    stage_index,
+                    worker.worker_id,
+                    e,
+                )
+                last_error = e
+        raise RequestFailed(
+            f"no worker could be configured for stage {stage_index}: "
+            f"{last_error}"
+        )
+
+    def _configure_with_timeout(
+        self, worker: StageWorker, stage_index: int
+    ) -> None:
+        """Bounded config handshake (reference ACK timeout,
+        ``src/dispatcher.py:246-260``)."""
+        done = threading.Event()
+        errors: list[Exception] = []
+
+        def _cfg():
+            try:
+                worker.configure(
+                    stage_index,
+                    self._stage_fns[stage_index],
+                    self._stage_host_vars[stage_index],
+                    spec=self.plan.stages[stage_index],
+                )
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_cfg, daemon=True)
+        t.start()
+        if not done.wait(self.config.fault.configure_timeout_s):
+            raise RequestFailed(
+                f"configure of stage {stage_index} on {worker.worker_id} "
+                f"timed out after {self.config.fault.configure_timeout_s}s"
+            )
+        if errors:
+            raise errors[0]
+
+    def _dispatch(
+        self,
+        request_id: int,
+        stage_index: int,
+        payload,
+        future: PipelineFuture,
+        attempt: int,
+        retries: int,
+        exclude: set[str] | None = None,
+    ) -> None:
+        worker = self._acquire(stage_index, exclude or set())
+        entry = _Inflight(
+            request_id=request_id,
+            stage_index=stage_index,
+            attempt=attempt,
+            payload=payload,
+            worker_id=worker.worker_id,
+            start_time=time.monotonic(),
+            retries=retries,
+            future=future,
+        )
+        with self._inflight_lock:
+            self._inflight[request_id] = entry
+        worker.submit(
+            Task(
+                request_id=request_id,
+                stage_index=stage_index,
+                attempt=attempt,
+                payload=payload,
+            )
+        )
+        global_metrics().inc("dispatcher.tasks_sent")
+
+    def _redispatch(self, entry: _Inflight, reason: str) -> None:
+        """Watchdog / failure path: re-send the retained payload to a
+        different worker (reference watchdog intent, ``src/dispatcher.py:
+        302-304`` + §2.7 'late binding')."""
+        if entry.retries + 1 > self.config.fault.max_retries:
+            with self._inflight_lock:
+                self._inflight.pop(entry.request_id, None)
+            self._finish(
+                entry.future,
+                error=(
+                    f"request {entry.request_id} stage {entry.stage_index} "
+                    f"failed after {entry.retries} retries ({reason})"
+                ),
+            )
+            return
+        global_metrics().inc("dispatcher.redispatched")
+        log.warning(
+            "re-dispatching request %d stage %d (%s), attempt %d",
+            entry.request_id,
+            entry.stage_index,
+            reason,
+            entry.attempt + 1,
+        )
+        try:
+            self._dispatch(
+                entry.request_id,
+                entry.stage_index,
+                entry.payload,
+                entry.future,
+                attempt=entry.attempt + 1,
+                retries=entry.retries + 1,
+                exclude={entry.worker_id},
+            )
+        except Exception as e:
+            with self._inflight_lock:
+                self._inflight.pop(entry.request_id, None)
+            self._finish(entry.future, error=str(e))
+
+    def _finish(self, future: PipelineFuture, value=None, error=None) -> None:
+        if future._complete(value, error):
+            self._sem.release()
+            global_metrics().inc(
+                "dispatcher.completed" if error is None else "dispatcher.failed"
+            )
+
+    # -- loops --------------------------------------------------------------
+
+    def _result_loop(self) -> None:
+        """The intermediate-result server (reference fragment
+        ``src/dispatcher.py:121-161``): every stage output returns to the
+        hub; forward to the next stage or emit the final result."""
+        while not self._shutdown.is_set():
+            result = self.result_queue.get()
+            if result is None:
+                break
+            with self._inflight_lock:
+                entry = self._inflight.get(result.request_id)
+                if (
+                    entry is None
+                    or entry.stage_index != result.stage_index
+                    or entry.attempt != result.attempt
+                ):
+                    # Stale duplicate (late completion after re-dispatch) —
+                    # the duplication bug the reference had (SURVEY §7.4).
+                    global_metrics().inc("dispatcher.stale_results")
+                    continue
+                del self._inflight[result.request_id]
+            if result.error is not None:
+                self._redispatch(entry, reason=f"error: {result.error}")
+                continue
+            next_stage = result.stage_index + 1
+            if next_stage < self.plan.num_stages:
+                try:
+                    self._dispatch(
+                        result.request_id,
+                        next_stage,
+                        result.output,
+                        entry.future,
+                        attempt=0,
+                        retries=0,
+                    )
+                except Exception as e:
+                    self._finish(entry.future, error=str(e))
+            else:
+                self._finish(entry.future, value=result.output)
+            stage_latency = time.monotonic() - entry.start_time
+            global_metrics().observe(
+                f"stage{result.stage_index}.latency_s", stage_latency
+            )
+
+    def _watchdog_loop(self) -> None:
+        """Deadline scan over the in-flight registry (the reference's
+        ``_task_watchdog``, ``src/dispatcher.py:302-304``, body lost —
+        rebuilt here)."""
+        period = self.config.fault.watchdog_period_s
+        deadline = self.config.fault.task_deadline_s
+        while not self._shutdown.wait(period):
+            if self._watchdog_paused:
+                continue
+            now = time.monotonic()
+            overdue: list[_Inflight] = []
+            with self._inflight_lock:
+                for rid, entry in list(self._inflight.items()):
+                    if now - entry.start_time > deadline:
+                        overdue.append(entry)
+                        del self._inflight[rid]
+            for entry in overdue:
+                self._redispatch(entry, reason="deadline exceeded")
+
+    def _on_membership(self, event: str, worker_id: str) -> None:
+        """Reference ``_worker_monitor`` (:276): on worker death, don't wait
+        for task deadlines — immediately re-dispatch its in-flight tasks."""
+        if event != "leave":
+            return
+        with self._inflight_lock:
+            orphaned = [
+                e for e in self._inflight.values() if e.worker_id == worker_id
+            ]
+            for e in orphaned:
+                del self._inflight[e.request_id]
+        for e in orphaned:
+            self._redispatch(e, reason=f"worker {worker_id} left")

@@ -1,0 +1,133 @@
+"""TTL-lease worker membership registry.
+
+TPU-native re-expression of the reference's etcd control plane: workers own
+key ``/workers/<ip>`` with a lease (``/root/reference/src/node_state.py:
+16-20``), the dispatcher reads the live pool at startup
+(``src/dispatcher.py:285-289``) and watches it continuously
+(``_worker_monitor``, call site ``:276``, body lost). Here membership is an
+in-process KV with TTL leases and watch callbacks — the dispatcher-side
+view is identical whether heartbeats arrive from an in-process worker
+thread (single-host: devices as workers) or, later, from a remote host over
+the comm transport.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections.abc import Callable
+
+from adapt_tpu.utils.logging import get_logger
+
+log = get_logger("registry")
+
+
+class WorkerRegistry:
+    """KV membership with TTL leases, expiry reaper, and join/leave watches."""
+
+    def __init__(self, default_ttl_s: float = 2.0, reap_period_s: float = 0.1):
+        self._lock = threading.Lock()
+        self._leases: dict[str, float] = {}  # worker_id -> expiry time
+        self._meta: dict[str, dict] = {}
+        self._watchers: list[Callable[[str, str], None]] = []
+        self._default_ttl = default_ttl_s
+        self._reap_period = reap_period_s
+        self._stop = threading.Event()
+        self._reaper: threading.Thread | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "WorkerRegistry":
+        if self._reaper is None:
+            self._reaper = threading.Thread(
+                target=self._reap_loop, name="registry-reaper", daemon=True
+            )
+            self._reaper.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._reaper is not None:
+            self._reaper.join(timeout=2.0)
+            self._reaper = None
+
+    # -- worker API (reference: node-side etcd writes) ----------------------
+
+    def register(
+        self, worker_id: str, meta: dict | None = None, ttl_s: float | None = None
+    ) -> None:
+        with self._lock:
+            fresh = worker_id not in self._leases
+            self._leases[worker_id] = time.monotonic() + (
+                ttl_s or self._default_ttl
+            )
+            self._meta[worker_id] = dict(meta or {})
+            watchers = list(self._watchers) if fresh else []
+        for cb in watchers:
+            cb("join", worker_id)
+        if fresh:
+            log.info("worker joined: %s", worker_id)
+
+    def heartbeat(self, worker_id: str, ttl_s: float | None = None) -> bool:
+        """Renew a lease; returns False if the lease already expired (the
+        worker must re-register — mirrors etcd lease keepalive semantics)."""
+        with self._lock:
+            if worker_id not in self._leases:
+                return False
+            self._leases[worker_id] = time.monotonic() + (
+                ttl_s or self._default_ttl
+            )
+            return True
+
+    def deregister(self, worker_id: str) -> None:
+        self._expire([worker_id], reason="deregister")
+
+    # -- dispatcher API (reference: _get_available_workers / _worker_monitor)
+
+    def alive(self) -> list[str]:
+        now = time.monotonic()
+        with self._lock:
+            return [w for w, exp in self._leases.items() if exp > now]
+
+    def meta(self, worker_id: str) -> dict:
+        with self._lock:
+            return dict(self._meta.get(worker_id, {}))
+
+    def watch(self, callback: Callable[[str, str], None]) -> None:
+        """callback(event, worker_id) with event in {'join', 'leave'}."""
+        with self._lock:
+            self._watchers.append(callback)
+
+    def wait_for_workers(self, n: int, timeout_s: float) -> bool:
+        """Bounded startup wait (reference: 5 s then clean shutdown,
+        ``src/dispatcher.py:282-295``)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if len(self.alive()) >= n:
+                return True
+            time.sleep(0.02)
+        return len(self.alive()) >= n
+
+    # -- internals ----------------------------------------------------------
+
+    def _expire(self, worker_ids: list[str], reason: str) -> None:
+        fired = []
+        with self._lock:
+            for w in worker_ids:
+                if w in self._leases:
+                    del self._leases[w]
+                    self._meta.pop(w, None)
+                    fired.append(w)
+            watchers = list(self._watchers)
+        for w in fired:
+            log.info("worker left (%s): %s", reason, w)
+            for cb in watchers:
+                cb("leave", w)
+
+    def _reap_loop(self) -> None:
+        while not self._stop.wait(self._reap_period):
+            now = time.monotonic()
+            with self._lock:
+                dead = [w for w, exp in self._leases.items() if exp <= now]
+            if dead:
+                self._expire(dead, reason="lease expired")

@@ -1,0 +1,231 @@
+"""Stage workers: device-owning executors with heartbeats and kill modes.
+
+The TPU-native analog of the reference's ``Node`` (``/root/reference/src/
+node.py``): a worker owns a compute resource (there: the whole machine's TF
+runtime; here: one JAX device), accepts stage configurations (there: model
+JSON + weights over port 6001 with an ACK, ``src/node.py:65-98``; here: a
+jitted stage fn + device_put of its variables), executes data tasks (there:
+``model.predict`` per request, ``:177``; here: the XLA stage program), and
+posts every result back to the dispatcher hub (Gen-2 star topology,
+``src/dispatcher.py:121-151``).
+
+Kill modes for fault injection (SURVEY.md §5 'chaos hook'):
+- ``crash``: stop heartbeating AND stop processing -> lease expiry evicts
+  the worker from membership.
+- ``hang``: keep heartbeating but stop processing -> only the task-deadline
+  watchdog can catch it (the harder failure; the reference's watchdog
+  exists for exactly this, ``src/dispatcher.py:302-304``).
+"""
+
+from __future__ import annotations
+
+import enum
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+
+from adapt_tpu.config import FaultConfig
+from adapt_tpu.control.registry import WorkerRegistry
+from adapt_tpu.utils.logging import get_logger
+from adapt_tpu.utils.metrics import global_metrics
+
+log = get_logger("worker")
+
+
+class WorkerState(enum.Enum):
+    """Reference ``StateEnum`` (``src/node_state.py:163-167``)."""
+
+    IDLE = "idle"
+    BUSY = "busy"
+    DEAD = "dead"
+
+
+@dataclass
+class Task:
+    """One stage-execution request (reference: 4-byte stage index + framed
+    payload on port 6000, ``src/dispatcher.py:209-213``)."""
+
+    request_id: int
+    stage_index: int
+    attempt: int
+    payload: Any  # host or device array
+
+
+@dataclass
+class TaskResult:
+    request_id: int
+    stage_index: int
+    attempt: int
+    worker_id: str
+    output: Any = None
+    error: str | None = None
+
+
+@dataclass
+class _StageBinding:
+    fn: Any  # shared jitted (variables, x) -> y
+    variables: Any  # device-resident
+    device: jax.Device
+    spec: Any = field(default=None)
+
+
+class StageWorker:
+    """In-process worker bound to one JAX device."""
+
+    def __init__(
+        self,
+        worker_id: str,
+        device: jax.Device,
+        registry: WorkerRegistry,
+        result_queue: "queue.Queue[TaskResult]",
+        fault: FaultConfig | None = None,
+    ):
+        self.worker_id = worker_id
+        self.device = device
+        self._registry = registry
+        self._results = result_queue
+        self._fault = fault or FaultConfig()
+        self._inbox: queue.Queue[Task | None] = queue.Queue()
+        self._bindings: dict[int, _StageBinding] = {}
+        self._bind_lock = threading.Lock()
+        self._state = WorkerState.IDLE
+        self._state_lock = threading.Lock()
+        self._crashed = threading.Event()
+        self._hung = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "StageWorker":
+        self._registry.register(
+            self.worker_id,
+            meta={"device": str(self.device)},
+            ttl_s=self._fault.lease_ttl_s,
+        )
+        for name, target in (
+            ("exec", self._exec_loop),
+            ("heartbeat", self._heartbeat_loop),
+        ):
+            t = threading.Thread(
+                target=target, name=f"{self.worker_id}-{name}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._crashed.set()
+        self._inbox.put(None)
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._registry.deregister(self.worker_id)
+
+    # -- fault injection ----------------------------------------------------
+
+    def kill(self, mode: str = "crash") -> None:
+        if mode == "crash":
+            self._crashed.set()
+            self._inbox.put(None)
+            log.warning("worker %s crashed (injected)", self.worker_id)
+        elif mode == "hang":
+            self._hung.set()
+            log.warning("worker %s hung (injected)", self.worker_id)
+        else:
+            raise ValueError(f"unknown kill mode {mode!r}")
+        with self._state_lock:
+            self._state = WorkerState.DEAD
+
+    # -- dispatcher-facing API ----------------------------------------------
+
+    @property
+    def state(self) -> WorkerState:
+        with self._state_lock:
+            return self._state
+
+    def is_configured(self, stage_index: int) -> bool:
+        with self._bind_lock:
+            return stage_index in self._bindings
+
+    def configure(self, stage_index: int, fn, host_variables, spec=None) -> None:
+        """Install a stage on this worker's device; returns when weights are
+        resident (the reference's JSON+weights+ACK handshake,
+        ``src/dispatcher.py:223-264`` / ``src/node.py:65-98``, collapsed to
+        a device_put + blocking ready wait)."""
+        if self._crashed.is_set():
+            raise RuntimeError(f"worker {self.worker_id} is dead")
+        variables = jax.device_put(host_variables, self.device)
+        jax.block_until_ready(variables)  # the ACK
+        with self._bind_lock:
+            self._bindings[stage_index] = _StageBinding(
+                fn=fn, variables=variables, device=self.device, spec=spec
+            )
+        global_metrics().inc("worker.configured")
+
+    def submit(self, task: Task) -> None:
+        self._inbox.put(task)
+
+    @property
+    def queue_depth(self) -> int:
+        return self._inbox.qsize()
+
+    # -- loops --------------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        # A crashed worker stops renewing; the registry reaper evicts it
+        # after lease_ttl (reference: etcd lease expiry on /workers/<ip>).
+        while not self._crashed.wait(self._fault.heartbeat_s):
+            self._registry.heartbeat(
+                self.worker_id, ttl_s=self._fault.lease_ttl_s
+            )
+
+    def _exec_loop(self) -> None:
+        while not self._crashed.is_set():
+            task = self._inbox.get()
+            if task is None or self._crashed.is_set():
+                break
+            if self._hung.is_set():
+                # Hung worker: swallow the task, never reply. The
+                # dispatcher's watchdog must recover it.
+                continue
+            with self._state_lock:
+                self._state = WorkerState.BUSY
+            try:
+                with self._bind_lock:
+                    binding = self._bindings.get(task.stage_index)
+                if binding is None:
+                    raise RuntimeError(
+                        f"stage {task.stage_index} not configured on "
+                        f"{self.worker_id}"
+                    )
+                x = jax.device_put(task.payload, self.device)
+                y = binding.fn(binding.variables, x)
+                y.block_until_ready()
+                self._results.put(
+                    TaskResult(
+                        request_id=task.request_id,
+                        stage_index=task.stage_index,
+                        attempt=task.attempt,
+                        worker_id=self.worker_id,
+                        output=y,
+                    )
+                )
+                global_metrics().inc("worker.tasks_ok")
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                log.error("worker %s task failed: %s", self.worker_id, e)
+                self._results.put(
+                    TaskResult(
+                        request_id=task.request_id,
+                        stage_index=task.stage_index,
+                        attempt=task.attempt,
+                        worker_id=self.worker_id,
+                        error=str(e),
+                    )
+                )
+                global_metrics().inc("worker.tasks_failed")
+            finally:
+                with self._state_lock:
+                    if self._state is not WorkerState.DEAD:
+                        self._state = WorkerState.IDLE

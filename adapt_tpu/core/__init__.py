@@ -1,0 +1,10 @@
+from adapt_tpu.core.mesh import MeshSpec, build_mesh, stage_devices
+from adapt_tpu.core.stage import CompiledStage, compile_stages
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "stage_devices",
+    "CompiledStage",
+    "compile_stages",
+]

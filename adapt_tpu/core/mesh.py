@@ -1,0 +1,67 @@
+"""Device discovery and mesh construction.
+
+The reference's "cluster" is a hand-edited IP list plus etcd discovery
+(``/root/reference/test/test.py:10``, ``src/node_state.py:16-20``). The
+TPU-native analog: the device pool is ``jax.devices()`` (chips over ICI),
+and placement is a ``jax.sharding.Mesh``. Stage->device binding is late
+(control plane decides at runtime which device hosts which stage), so the
+mesh helpers here are deliberately small and stateless.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A named logical mesh shape, e.g. (dp=2, pp=4) over 8 chips."""
+
+    axes: tuple[tuple[str, int], ...]
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(name for name, _ in self.axes)
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return tuple(size for _, size in self.axes)
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(self.shape)) if self.axes else 1
+
+
+def build_mesh(
+    spec: MeshSpec, devices: Sequence[jax.Device] | None = None
+) -> Mesh:
+    """Build a Mesh from the first ``spec.num_devices`` devices.
+
+    On real hardware, ``jax.devices()`` order follows the ICI torus
+    enumeration, so contiguous slices keep collectives on ICI rather than
+    DCN (the scaling-book recipe; the reference's analog — TCP peer lists —
+    has no locality notion at all)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = spec.num_devices
+    if len(devices) < n:
+        raise ValueError(
+            f"mesh {spec} needs {n} devices, only {len(devices)} available"
+        )
+    arr = np.array(devices[:n]).reshape(spec.shape)
+    return Mesh(arr, spec.axis_names)
+
+
+def stage_devices(
+    num_stages: int, devices: Sequence[jax.Device] | None = None
+) -> list[jax.Device]:
+    """Round-robin device assignment for pipeline stages (the initial
+    binding; the control plane may rebind later on failure)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if not devices:
+        raise RuntimeError("no JAX devices")
+    return [devices[i % len(devices)] for i in range(num_stages)]

@@ -1,0 +1,89 @@
+"""Compiled pipeline stages: the TPU-native worker executor.
+
+Replaces the reference's per-worker Keras slice executor
+(``/root/reference/src/node.py:40-45`` builds `model_from_json`+
+`set_weights`; ``:177`` runs `model.predict` per request). Here a stage is
+an XLA program: the stage's sub-DAG jit-compiled with its variables resident
+on a specific device. "Configuring a worker" (reference: re-send JSON+weights
+over TCP, ``src/dispatcher.py:223-264``) becomes placing the variable pytree
+on the target device and reusing the jit cache — the compiled executable is
+shared across devices of the same kind, so re-binding a stage to a new
+device is a weight transfer, not a recompile (the <2 s recovery budget,
+SURVEY.md §7.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Mapping, Sequence
+from typing import Any
+
+import jax
+
+from adapt_tpu.graph.ir import Variables
+from adapt_tpu.graph.partition import PartitionPlan, StageSpec
+
+
+@dataclasses.dataclass
+class CompiledStage:
+    """A stage bound to a device: jitted apply + device-resident variables.
+
+    ``host_variables`` stays on host (the dispatcher-side master copy the
+    reference keeps to reconfigure workers on demand); ``variables`` is the
+    device copy actually used by ``__call__``.
+    """
+
+    spec: StageSpec
+    fn: Any  # jitted (variables, x) -> y
+    device: jax.Device
+    variables: Mapping[str, Variables]
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = jax.device_put(x, self.device)
+        return self.fn(self.variables, x)
+
+    def rebind(self, device: jax.Device, host_variables) -> "CompiledStage":
+        """Re-materialize this stage on another device (failure recovery /
+        late binding). jit reuses the compiled executable for the new
+        device; only weights move."""
+        return CompiledStage(
+            spec=self.spec,
+            fn=self.fn,
+            device=device,
+            variables=jax.device_put(host_variables, device),
+        )
+
+
+def compile_stages(
+    plan: PartitionPlan,
+    variables: Mapping[str, Variables],
+    devices: Sequence[jax.Device],
+    donate_activations: bool = False,
+) -> list[CompiledStage]:
+    """Build one CompiledStage per plan stage, round-robin over devices.
+
+    ``donate_activations``: donate the input activation buffer to XLA,
+    saving HBM on large activations. Only enable when callers never reuse
+    the arrays they pass in: donation aliases the caller's buffer whenever
+    it already lives on the stage device (device_put is then a no-op), so a
+    reused input would be a use-after-donate error.
+    """
+    if not devices:
+        raise ValueError("no devices")
+    stage_vars = plan.extract_variables(variables)
+    out = []
+    for spec, svars in zip(plan.stages, stage_vars):
+        device = devices[spec.index % len(devices)]
+        fn = jax.jit(
+            plan.stage_apply(spec),
+            donate_argnums=(1,) if donate_activations else (),
+        )
+        out.append(
+            CompiledStage(
+                spec=spec,
+                fn=fn,
+                device=device,
+                variables=jax.device_put(svars, device),
+            )
+        )
+    return out

@@ -144,7 +144,9 @@ class LayerGraph:
         self, variables: Mapping[str, Variables], x: jax.Array
     ) -> jax.Array:
         """Run the full graph (un-partitioned); the single-device path."""
-        return self.apply_subset(variables, self.topo_order(), {INPUT: x})
+        return self.apply_subset(
+            variables, self.topo_order(), {INPUT: x}, output=self.output
+        )
 
     def apply_subset(
         self,

@@ -1,0 +1,34 @@
+"""Model zoo: the reference's benchmark workloads, declared as LayerGraphs.
+
+Reference workloads (BASELINE.json configs): ResNet-50 (``/root/reference/
+test/test.py:13``, ``test/local_infer.py:8``), plus ResNet-152,
+EfficientNet-B4 and ViT-B/16 from the build targets. Node names follow the
+Keras layer naming the reference cuts on (e.g. ``conv3_block1_out``,
+``test/test.py:18``) so cut lists transfer directly.
+"""
+
+from adapt_tpu.models.efficientnet import efficientnet_b0, efficientnet_b4
+from adapt_tpu.models.resnet import resnet50, resnet101, resnet152
+from adapt_tpu.models.vit import vit_b16, vit_tiny
+
+#: name -> (graph factory, canonical input shape HWC)
+MODEL_REGISTRY = {
+    "resnet50": (resnet50, (224, 224, 3)),
+    "resnet101": (resnet101, (224, 224, 3)),
+    "resnet152": (resnet152, (224, 224, 3)),
+    "efficientnet_b0": (efficientnet_b0, (224, 224, 3)),
+    "efficientnet_b4": (efficientnet_b4, (380, 380, 3)),
+    "vit_b16": (vit_b16, (224, 224, 3)),
+    "vit_tiny": (vit_tiny, (32, 32, 3)),
+}
+
+__all__ = [
+    "MODEL_REGISTRY",
+    "resnet50",
+    "resnet101",
+    "resnet152",
+    "efficientnet_b0",
+    "efficientnet_b4",
+    "vit_b16",
+    "vit_tiny",
+]

@@ -1,0 +1,159 @@
+"""Shared flax building blocks for the model zoo.
+
+TPU notes: compute dtype is configurable (bf16 keeps matmuls/convs on the
+MXU at full rate; params stay f32). BatchNorm always runs in inference mode
+(`use_running_average=True`) — parity scope is inference-only
+(SURVEY.md §2.8: the reference has no training path).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    """Conv + BatchNorm(+ optional activation) — the Keras `X_conv`/`X_bn`
+    pair the reference's models are made of."""
+
+    features: int
+    kernel: tuple[int, int] = (3, 3)
+    strides: int = 1
+    groups: int = 1
+    act: Callable | None = jax.nn.relu
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(
+            self.features,
+            self.kernel,
+            strides=self.strides,
+            padding="SAME",
+            use_bias=False,
+            feature_group_count=self.groups,
+            dtype=self.dtype,
+        )(x)
+        x = nn.BatchNorm(use_running_average=True, dtype=self.dtype)(x)
+        if self.act is not None:
+            x = self.act(x)
+        return x
+
+
+class ResNetStem(nn.Module):
+    """7x7/2 conv + 3x3/2 maxpool (Keras `conv1_*` + `pool1_pool`)."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = ConvBN(64, (7, 7), strides=2, dtype=self.dtype)(x)
+        return nn.max_pool(
+            x, window_shape=(3, 3), strides=(2, 2), padding="SAME"
+        )
+
+
+class BottleneckBranch(nn.Module):
+    """The residual branch of a ResNet bottleneck block: 1x1 -> 3x3 -> 1x1
+    (x4 filters), no activation after the last BN (the add supplies it)."""
+
+    filters: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = ConvBN(self.filters, (1, 1), strides=self.strides, dtype=self.dtype)(x)
+        x = ConvBN(self.filters, (3, 3), dtype=self.dtype)(x)
+        return ConvBN(4 * self.filters, (1, 1), act=None, dtype=self.dtype)(x)
+
+
+class Projection(nn.Module):
+    """1x1 projection shortcut (Keras `_0_conv`/`_0_bn`)."""
+
+    features: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        return ConvBN(
+            self.features, (1, 1), strides=self.strides, act=None, dtype=self.dtype
+        )(x)
+
+
+class ClassifierHead(nn.Module):
+    """Global average pool + Dense (Keras `avg_pool` + `predictions`)."""
+
+    num_classes: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = jnp.mean(x, axis=(1, 2))
+        # Logits in f32 for stable softmax downstream.
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )
+
+
+class SqueezeExcite(nn.Module):
+    """SE block (EfficientNet): global pool -> reduce -> swish -> expand ->
+    sigmoid gate."""
+
+    reduced: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.Conv(self.reduced, (1, 1), dtype=self.dtype)(s)
+        s = jax.nn.silu(s)
+        s = nn.Conv(x.shape[-1], (1, 1), dtype=self.dtype)(s)
+        return x * jax.nn.sigmoid(s)
+
+
+class MBConvBranch(nn.Module):
+    """EfficientNet MBConv body: expand 1x1 -> depthwise kxk -> SE ->
+    project 1x1 (no activation after project)."""
+
+    in_filters: int
+    out_filters: int
+    kernel: int = 3
+    strides: int = 1
+    expand_ratio: int = 6
+    se_ratio: float = 0.25
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        expanded = self.in_filters * self.expand_ratio
+        if self.expand_ratio != 1:
+            x = ConvBN(expanded, (1, 1), act=jax.nn.silu, dtype=self.dtype)(x)
+        x = ConvBN(
+            expanded,
+            (self.kernel, self.kernel),
+            strides=self.strides,
+            groups=expanded,
+            act=jax.nn.silu,
+            dtype=self.dtype,
+        )(x)
+        if self.se_ratio > 0:
+            x = SqueezeExcite(
+                max(1, int(self.in_filters * self.se_ratio)), dtype=self.dtype
+            )(x)
+        return ConvBN(self.out_filters, (1, 1), act=None, dtype=self.dtype)(x)
+
+
+class Cast(nn.Module):
+    """Dtype cast node (e.g. f32 input -> bf16 compute at the stem)."""
+
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        return x.astype(self.dtype)

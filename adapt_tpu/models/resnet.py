@@ -1,0 +1,99 @@
+"""ResNet-50/101/152 as LayerGraphs with Keras-compatible node names.
+
+The reference's headline workload (``/root/reference/test/test.py:13``
+loads Keras ResNet-50 and cuts it at named layers, ``:18``). Here each
+residual block is three DAG nodes — branch, (projection) shortcut, merge —
+so the graph has real joins and the partitioner's dominator validation is
+exercised exactly as on the Keras graph. Merge nodes are named
+``conv{S}_block{B}_out`` matching Keras's post-add activation layer names,
+so reference cut lists transfer verbatim.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from adapt_tpu.graph.ir import INPUT, LayerGraph, Lambda
+from adapt_tpu.models.layers import (
+    BottleneckBranch,
+    ClassifierHead,
+    Projection,
+    ResNetStem,
+)
+
+#: blocks per stage (conv2..conv5), Keras ResNetXX layouts.
+_DEPTHS = {
+    50: (3, 4, 6, 3),
+    101: (3, 4, 23, 3),
+    152: (3, 8, 36, 3),
+}
+_FILTERS = (64, 128, 256, 512)
+
+
+def _add_relu():
+    return Lambda(lambda shortcut, branch: jax.nn.relu(shortcut + branch), "add_relu")
+
+
+def resnet(
+    depth: int,
+    num_classes: int = 1000,
+    dtype: jnp.dtype = jnp.float32,
+) -> LayerGraph:
+    if depth not in _DEPTHS:
+        raise ValueError(f"unsupported ResNet depth {depth}; have {list(_DEPTHS)}")
+    g = LayerGraph(f"resnet{depth}")
+    g.add("stem", ResNetStem(dtype=dtype), INPUT)
+    prev = "stem"
+    for stage_idx, (blocks, filters) in enumerate(
+        zip(_DEPTHS[depth], _FILTERS), start=2
+    ):
+        for b in range(1, blocks + 1):
+            name = f"conv{stage_idx}_block{b}"
+            strides = 2 if (b == 1 and stage_idx > 2) else 1
+            branch = g.add(
+                f"{name}_branch",
+                BottleneckBranch(filters, strides=strides, dtype=dtype),
+                prev,
+            )
+            if b == 1:
+                shortcut = g.add(
+                    f"{name}_short",
+                    Projection(4 * filters, strides=strides, dtype=dtype),
+                    prev,
+                )
+            else:
+                shortcut = prev
+            prev = g.add(f"{name}_out", _add_relu(), (shortcut, branch))
+    g.add("head", ClassifierHead(num_classes, dtype=dtype), prev)
+    return g
+
+
+def resnet50(num_classes: int = 1000, dtype: jnp.dtype = jnp.float32) -> LayerGraph:
+    return resnet(50, num_classes, dtype)
+
+
+def resnet101(num_classes: int = 1000, dtype: jnp.dtype = jnp.float32) -> LayerGraph:
+    return resnet(101, num_classes, dtype)
+
+
+def resnet152(num_classes: int = 1000, dtype: jnp.dtype = jnp.float32) -> LayerGraph:
+    return resnet(152, num_classes, dtype)
+
+
+#: BASELINE.json config 2: "ResNet-50 split at conv3_block1/conv4_block1
+#: into 3 pjit stages" — boundaries at the outputs of the blocks *before*
+#: conv3_block1 and conv4_block1 (a cut at layer L means L's output is the
+#: boundary, SURVEY.md §2.4).
+RESNET50_3STAGE_CUTS = ("conv2_block3_out", "conv3_block4_out")
+
+#: BASELINE.json config 3: ResNet-152 into 8 stages.
+RESNET152_8STAGE_CUTS = (
+    "conv2_block3_out",
+    "conv3_block4_out",
+    "conv3_block8_out",
+    "conv4_block9_out",
+    "conv4_block18_out",
+    "conv4_block27_out",
+    "conv4_block36_out",
+)

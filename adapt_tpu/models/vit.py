@@ -1,0 +1,127 @@
+"""ViT-B/16 as a LayerGraph cut by transformer block.
+
+BASELINE.json config 5: "ViT-B/16 encoder split by transformer block,
+kill-one-stage fault-injection". Each encoder block (pre-LN MHA + MLP with
+internal residuals) is one node named ``encoder_block_{i}``, so every block
+boundary is a valid cut point — the transformer analog of the reference's
+layer-name cuts. The homogeneous block structure also admits the stacked
+SPMD pipeline path in ``adapt_tpu.parallel`` (scan-over-blocks +
+``ppermute``), which this per-node graph form complements.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from adapt_tpu.graph.ir import INPUT, LayerGraph
+
+
+class PatchEmbed(nn.Module):
+    """Patchify conv + [CLS] token + learned position embeddings."""
+
+    patch: int
+    dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.dim,
+            (self.patch, self.patch),
+            strides=self.patch,
+            padding="VALID",
+            dtype=self.dtype,
+        )(x)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+        cls = self.param(
+            "cls", nn.initializers.zeros, (1, 1, self.dim), jnp.float32
+        ).astype(self.dtype)
+        x = jnp.concatenate([jnp.tile(cls, (b, 1, 1)), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, h * w + 1, self.dim),
+            jnp.float32,
+        ).astype(self.dtype)
+        return x + pos
+
+
+class EncoderBlock(nn.Module):
+    """Pre-LN transformer encoder block (residuals kept inside the node, so
+    inter-block edges are clean pipeline boundaries)."""
+
+    dim: int
+    heads: int
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads,
+            qkv_features=self.dim,
+            dtype=self.dtype,
+        )(y, y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.dim, dtype=self.dtype)(y)
+        return x + y
+
+
+class ViTHead(nn.Module):
+    """Final LN + CLS-token classifier."""
+
+    num_classes: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(
+            x[:, 0].astype(jnp.float32)
+        )
+
+
+def vit(
+    patch: int,
+    dim: int,
+    depth: int,
+    heads: int,
+    mlp_dim: int,
+    num_classes: int = 1000,
+    dtype: jnp.dtype = jnp.float32,
+    name: str = "vit",
+) -> LayerGraph:
+    g = LayerGraph(name)
+    prev = g.add("patch_embed", PatchEmbed(patch, dim, dtype=dtype), INPUT)
+    for i in range(depth):
+        prev = g.add(
+            f"encoder_block_{i}",
+            EncoderBlock(dim, heads, mlp_dim, dtype=dtype),
+            prev,
+        )
+    g.add("head", ViTHead(num_classes, dtype=dtype), prev)
+    return g
+
+
+def vit_b16(num_classes: int = 1000, dtype: jnp.dtype = jnp.float32) -> LayerGraph:
+    return vit(16, 768, 12, 12, 3072, num_classes, dtype, name="vit_b16")
+
+
+def vit_tiny(num_classes: int = 10, dtype: jnp.dtype = jnp.float32) -> LayerGraph:
+    """Small ViT for tests (32x32/4 patches, 4 blocks)."""
+    return vit(4, 64, 4, 4, 128, num_classes, dtype, name="vit_tiny")
+
+
+def vit_block_cuts(depth: int, num_stages: int) -> list[str]:
+    """Evenly split ``depth`` encoder blocks into ``num_stages`` stages."""
+    if num_stages < 2:
+        return []
+    bounds = [round(k * depth / num_stages) for k in range(1, num_stages)]
+    return [f"encoder_block_{b - 1}" for b in bounds]

@@ -1,0 +1,3 @@
+from adapt_tpu.runtime.pipeline import LocalPipeline, ServingPipeline
+
+__all__ = ["LocalPipeline", "ServingPipeline"]

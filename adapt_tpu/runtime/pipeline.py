@@ -1,0 +1,188 @@
+"""Serving pipelines: the reference drivers' semantics, two ways.
+
+``LocalPipeline`` — the fast path with *static* stage->device binding
+(Gen-1 chain topology, ``/root/reference/src/node.py:163-179``): stages are
+jit programs pinned to devices, activations hop device-to-device directly
+(ICI on a real pod), a thread per stage keeps every stage busy so requests
+pipeline (the reference's decoupled pump/collect, ``src/dispatcher.py:
+99-119``). No adaptivity; maximum throughput.
+
+``ServingPipeline`` — the adaptive path (Gen-2 star): wraps
+``control.Dispatcher`` + workers for late binding, membership, watchdog
+re-dispatch. Same queue-in/queue-out API, so the two are interchangeable in
+drivers and benchmarks — the A/B the reference runs by hand
+(``test/test.py`` vs ``test/local_infer.py``) is a constructor swap here.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections.abc import Iterable, Sequence
+from typing import Any
+
+import jax
+
+from adapt_tpu.config import ServeConfig
+from adapt_tpu.control.dispatcher import Dispatcher
+from adapt_tpu.control.registry import WorkerRegistry
+from adapt_tpu.core.stage import CompiledStage, compile_stages
+from adapt_tpu.graph.partition import PartitionPlan
+from adapt_tpu.utils.metrics import global_metrics
+
+_SENTINEL = object()
+
+
+class _StageError:
+    """Error marker propagated through the stage queues so a failing stage
+    can't strand the stream consumer."""
+
+    def __init__(self, stage_index: int, exc: Exception):
+        self.stage_index = stage_index
+        self.exc = exc
+
+
+class LocalPipeline:
+    """Static-chain pipelined inference over a device list."""
+
+    def __init__(
+        self,
+        plan: PartitionPlan,
+        variables,
+        devices: Sequence[jax.Device] | None = None,
+        donate_activations: bool = False,
+    ):
+        devices = list(devices if devices is not None else jax.devices())
+        self.plan = plan
+        self.stages: list[CompiledStage] = compile_stages(
+            plan, variables, devices, donate_activations=donate_activations
+        )
+
+    def infer(self, x) -> jax.Array:
+        """Single-request path (latency)."""
+        for stage in self.stages:
+            x = stage(x)
+        return x
+
+    def warmup(self, example) -> None:
+        jax.block_until_ready(self.infer(example))
+
+    def stream(self, inputs: Iterable[Any]) -> list[jax.Array]:
+        """Throughput path: a thread per stage connected by depth-bounded
+        queues; all stages run concurrently on their devices (XLA dispatch
+        is async, so device i computes request r while device i+1 computes
+        r-1 — true pipelining)."""
+        n_stages = len(self.stages)
+        qs: list[queue.Queue] = [queue.Queue(maxsize=4) for _ in range(n_stages + 1)]
+        outputs: list[jax.Array] = []
+
+        def stage_loop(i: int):
+            stage = self.stages[i]
+            while True:
+                item = qs[i].get()
+                if item is _SENTINEL or isinstance(item, _StageError):
+                    qs[i + 1].put(item)  # propagate shutdown/error downstream
+                    break
+                try:
+                    y = stage(item)
+                except Exception as e:  # noqa: BLE001 — surface to caller
+                    qs[i + 1].put(_StageError(stage.spec.index, e))
+                    break
+                qs[i + 1].put(y)
+
+        threads = [
+            threading.Thread(target=stage_loop, args=(i,), daemon=True)
+            for i in range(n_stages)
+        ]
+        for t in threads:
+            t.start()
+
+        def feed():
+            for x in inputs:
+                qs[0].put(x)
+            qs[0].put(_SENTINEL)
+
+        feeder = threading.Thread(target=feed, daemon=True)
+        feeder.start()
+        error: _StageError | None = None
+        while True:
+            y = qs[-1].get()
+            if isinstance(y, _StageError):
+                error = y
+                break
+            if y is _SENTINEL:
+                break
+            outputs.append(y)
+        if error is not None:
+            raise RuntimeError(
+                f"stage {error.stage_index} failed during stream"
+            ) from error.exc
+        feeder.join()
+        for t in threads:
+            t.join()
+        return outputs
+
+    def throughput(self, inputs: Sequence[Any]) -> tuple[list, float]:
+        """Timed stream: returns (outputs, wall_seconds) — the reference's
+        benchmark measurement (``test/test.py:25-37``)."""
+        start = time.perf_counter()
+        outputs = self.stream(inputs)
+        jax.block_until_ready(outputs[-1])
+        return outputs, time.perf_counter() - start
+
+
+class ServingPipeline:
+    """Adaptive serving: dispatcher + workers + membership + watchdog."""
+
+    def __init__(
+        self,
+        plan: PartitionPlan,
+        variables,
+        devices: Sequence[jax.Device] | None = None,
+        config: ServeConfig | None = None,
+    ):
+        devices = list(devices if devices is not None else jax.devices())
+        self.config = config or ServeConfig()
+        self.registry = WorkerRegistry(
+            default_ttl_s=self.config.fault.lease_ttl_s
+        )
+        self.dispatcher = Dispatcher(
+            plan, variables, registry=self.registry, config=self.config
+        )
+        self.workers = self.dispatcher.spawn_workers(devices)
+
+    def start(self) -> "ServingPipeline":
+        self.dispatcher.start()
+        return self
+
+    def shutdown(self) -> None:
+        self.dispatcher.shutdown()
+
+    def __enter__(self) -> "ServingPipeline":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    def infer(self, x, timeout: float | None = 120.0):
+        return self.dispatcher.infer(x, timeout)
+
+    def warmup(self, example, timeout: float | None = 300.0) -> None:
+        self.dispatcher.warmup(example, timeout)
+
+    def stream(self, inputs: Iterable[Any], timeout_per_request: float = 120.0):
+        return self.dispatcher.serve_stream(inputs, timeout_per_request)
+
+    def throughput(self, inputs: Sequence[Any]) -> tuple[list, float]:
+        start = time.perf_counter()
+        outputs = self.stream(inputs)
+        jax.block_until_ready(outputs[-1])
+        return outputs, time.perf_counter() - start
+
+    def kill_worker(self, index: int, mode: str = "crash") -> None:
+        """Chaos hook (SURVEY.md §5): kill one worker by index."""
+        self.workers[index].kill(mode)
+
+    def metrics(self) -> dict:
+        return global_metrics().snapshot()

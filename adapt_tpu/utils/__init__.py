@@ -1,0 +1,11 @@
+from adapt_tpu.utils.logging import get_logger
+from adapt_tpu.utils.metrics import MetricsRegistry, global_metrics
+from adapt_tpu.utils.tracing import Tracer, global_tracer
+
+__all__ = [
+    "get_logger",
+    "MetricsRegistry",
+    "global_metrics",
+    "Tracer",
+    "global_tracer",
+]

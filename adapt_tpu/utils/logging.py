@@ -1,0 +1,45 @@
+"""Structured logging.
+
+The reference's observability is bare ``print()`` (e.g.
+``/root/reference/src/dispatcher.py:129,147-150,198``). Framework-owned
+replacement: stdlib logging with a compact single-line formatter carrying
+component + key=value fields, quiet by default (WARNING) so the serving hot
+path never blocks on stdout; ``ADAPT_TPU_LOG=debug`` to turn up.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_CONFIGURED = False
+
+
+def _configure_root() -> None:
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    level = os.environ.get("ADAPT_TPU_LOG", "warning").upper()
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s.%(msecs)03d %(levelname).1s %(name)s: %(message)s",
+            datefmt="%H:%M:%S",
+        )
+    )
+    root = logging.getLogger("adapt_tpu")
+    root.addHandler(handler)
+    root.setLevel(getattr(logging, level, logging.WARNING))
+    root.propagate = False
+    _CONFIGURED = True
+
+
+def get_logger(component: str) -> logging.Logger:
+    _configure_root()
+    return logging.getLogger(f"adapt_tpu.{component}")
+
+
+def kv(**fields) -> str:
+    """Render key=value fields for structured log lines."""
+    return " ".join(f"{k}={v}" for k, v in fields.items())

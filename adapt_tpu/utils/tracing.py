@@ -1,0 +1,76 @@
+"""Request/stage tracing.
+
+Absent from the reference (SURVEY.md §5: only per-task ``start_time``
+stamps, ``src/dispatcher.py:193``). Provides span recording for the serving
+path plus an optional bridge to ``jax.profiler`` traces for XLA-level
+profiling on TPU.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    end: float = 0.0
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def duration(self) -> float:
+        return self.end - self.start
+
+
+class Tracer:
+    def __init__(self, capacity: int = 65536):
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+        self._capacity = capacity
+        self.enabled = False
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        if not self.enabled:
+            yield None
+            return
+        s = Span(name=name, start=time.perf_counter(), attrs=attrs)
+        try:
+            yield s
+        finally:
+            s.end = time.perf_counter()
+            with self._lock:
+                if len(self._spans) < self._capacity:
+                    self._spans.append(s)
+
+    def spans(self, name: str | None = None) -> list[Span]:
+        with self._lock:
+            return [
+                s for s in self._spans if name is None or s.name == name
+            ]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    @contextlib.contextmanager
+    def device_trace(self, logdir: str):
+        """XLA-level profiling (TensorBoard-viewable) around a region."""
+        import jax
+
+        jax.profiler.start_trace(logdir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+
+
+_GLOBAL = Tracer()
+
+
+def global_tracer() -> Tracer:
+    return _GLOBAL

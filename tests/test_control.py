@@ -1,0 +1,266 @@
+"""Control-plane tests: membership, late binding, pipelined serving,
+fault injection (crash + hang), exactly-once under re-dispatch.
+
+This is the test coverage the reference never had for its headline feature
+(SURVEY.md §2.7, §4): kill one stage worker mid-stream and assert recovery
+with no lost or duplicated requests.
+"""
+
+import time
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adapt_tpu.config import FaultConfig, ServeConfig
+from adapt_tpu.control import WorkerRegistry
+from adapt_tpu.control.dispatcher import RequestFailed
+from adapt_tpu.graph import INPUT, LayerGraph, partition
+from adapt_tpu.graph.ir import Lambda
+from adapt_tpu.runtime import LocalPipeline, ServingPipeline
+from adapt_tpu.utils.metrics import global_metrics
+
+
+def chain_graph(width=8, depth=4):
+    g = LayerGraph("chain")
+    prev = INPUT
+    for i in range(depth):
+        prev = g.add(f"dense{i}", nn.Dense(width), prev)
+    g.add("head", Lambda(lambda x: x * 2.0, "double"), prev)
+    return g
+
+
+@pytest.fixture
+def small_model(rng):
+    g = chain_graph()
+    x = jnp.ones((2, 8))
+    variables = g.init(rng, x)
+    plan = partition(g, ["dense0", "dense2"])  # 3 stages
+    return g, variables, plan, x
+
+
+FAST_FAULT = FaultConfig(
+    lease_ttl_s=0.4,
+    heartbeat_s=0.1,
+    task_deadline_s=1.0,
+    watchdog_period_s=0.05,
+    startup_wait_s=2.0,
+)
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_registry_lease_expiry():
+    reg = WorkerRegistry(default_ttl_s=0.2, reap_period_s=0.02).start()
+    events = []
+    reg.watch(lambda ev, w: events.append((ev, w)))
+    reg.register("w0")
+    assert reg.alive() == ["w0"]
+    # Heartbeats keep it alive past one TTL.
+    for _ in range(5):
+        time.sleep(0.05)
+        assert reg.heartbeat("w0")
+    assert reg.alive() == ["w0"]
+    # Stop heartbeating -> reaped.
+    time.sleep(0.4)
+    assert reg.alive() == []
+    assert not reg.heartbeat("w0")  # expired lease cannot renew
+    assert ("leave", "w0") in events
+    reg.stop()
+
+
+def test_registry_bounded_startup_wait():
+    reg = WorkerRegistry().start()
+    t0 = time.monotonic()
+    assert not reg.wait_for_workers(1, timeout_s=0.3)
+    assert 0.25 < time.monotonic() - t0 < 1.0
+    reg.stop()
+
+
+# -- serving happy path -----------------------------------------------------
+
+
+def test_local_pipeline_matches_model(small_model, devices):
+    g, variables, plan, x = small_model
+    pipe = LocalPipeline(plan, variables, devices[:3])
+    y = pipe.infer(x)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(g.apply(variables, x)), rtol=1e-6
+    )
+
+
+def test_local_pipeline_stream_order(small_model, devices):
+    g, variables, plan, _ = small_model
+    pipe = LocalPipeline(plan, variables, devices[:3])
+    inputs = [jnp.full((2, 8), float(i)) for i in range(12)]
+    outputs = pipe.stream(inputs)
+    assert len(outputs) == 12
+    for x, y in zip(inputs, outputs):
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(g.apply(variables, x)), rtol=1e-5
+        )
+
+
+def test_serving_pipeline_basic(small_model, devices):
+    g, variables, plan, x = small_model
+    global_metrics().reset()
+    cfg = ServeConfig(fault=FAST_FAULT)
+    with ServingPipeline(plan, variables, devices[:4], cfg) as pipe:
+        y = pipe.infer(x)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(g.apply(variables, x)), rtol=1e-6
+        )
+        outs = pipe.stream([x] * 8)
+        assert len(outs) == 8
+
+
+def test_no_workers_clean_shutdown(small_model):
+    _, variables, plan, _ = small_model
+    cfg = ServeConfig(fault=FaultConfig(startup_wait_s=0.3))
+    pipe = ServingPipeline(plan, variables, devices=[], config=cfg)
+    # No devices -> no workers ever register -> bounded-wait abort
+    # (reference behavior at src/dispatcher.py:290-295).
+    with pytest.raises(RequestFailed, match="no workers"):
+        pipe.start()
+
+
+# -- fault injection --------------------------------------------------------
+
+
+def test_crash_recovery_no_lost_requests(small_model, devices):
+    """Kill a worker mid-stream (crash: heartbeats stop). All requests must
+    still complete with correct values — membership eviction triggers
+    immediate re-dispatch of its in-flight tasks."""
+    g, variables, plan, _ = small_model
+    global_metrics().reset()
+    cfg = ServeConfig(max_inflight=4, fault=FAST_FAULT)
+    pipe = ServingPipeline(plan, variables, devices[:4], cfg)
+    with pipe:
+        inputs = [jnp.full((2, 8), float(i)) for i in range(20)]
+        futures = []
+        for i, x in enumerate(inputs):
+            futures.append(pipe.dispatcher.submit(x))
+            if i == 5:
+                pipe.kill_worker(0, mode="crash")
+        results = [f.result(30.0) for f in futures]
+    for x, y in zip(inputs, results):
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(g.apply(variables, x)), rtol=1e-5
+        )
+
+
+def test_hang_recovery_via_watchdog(small_model, devices):
+    """Hung worker keeps heartbeating — only the task-deadline watchdog can
+    recover (the reference's _task_watchdog scenario)."""
+    g, variables, plan, x = small_model
+    global_metrics().reset()
+    cfg = ServeConfig(max_inflight=2, fault=FAST_FAULT)
+    pipe = ServingPipeline(plan, variables, devices[:3], cfg)
+    with pipe:
+        # Prime all workers with configs so the hung worker is a candidate.
+        pipe.infer(x)
+        pipe.kill_worker(1, mode="hang")
+        t0 = time.monotonic()
+        outs = pipe.stream([x] * 6, timeout_per_request=30.0)
+        elapsed = time.monotonic() - t0
+    assert len(outs) == 6
+    m = global_metrics().snapshot()["counters"]
+    # If the hung worker ever swallowed a task, the watchdog must have fired.
+    # (It may have been idle-skipped; either way all requests completed.)
+    assert m.get("dispatcher.completed", 0) >= 6
+    assert elapsed < 25.0
+
+
+def test_all_workers_dead_fails_requests(small_model, devices):
+    _, variables, plan, x = small_model
+    cfg = ServeConfig(
+        fault=FaultConfig(
+            lease_ttl_s=0.3,
+            heartbeat_s=0.1,
+            task_deadline_s=0.5,
+            watchdog_period_s=0.05,
+            startup_wait_s=1.0,
+            max_retries=2,
+        )
+    )
+    pipe = ServingPipeline(plan, variables, devices[:2], cfg)
+    with pipe:
+        pipe.infer(x)  # healthy first
+        for w in pipe.workers:
+            w.kill("crash")
+        time.sleep(0.5)  # let leases expire
+        with pytest.raises(RequestFailed):
+            pipe.dispatcher.submit(x).result(10.0)
+
+
+def test_exactly_once_under_redispatch(small_model, devices):
+    """A late result from a presumed-dead attempt must not complete the
+    future twice nor corrupt a newer attempt (SURVEY §7.4 exactly-once)."""
+    g, variables, plan, x = small_model
+    global_metrics().reset()
+    cfg = ServeConfig(max_inflight=8, fault=FAST_FAULT)
+    pipe = ServingPipeline(plan, variables, devices[:4], cfg)
+    with pipe:
+        # Hang one worker, push load through, then assert completions ==
+        # submissions exactly.
+        pipe.infer(x)
+        pipe.kill_worker(2, mode="hang")
+        outs = pipe.stream([x] * 10, timeout_per_request=30.0)
+        assert len(outs) == 10
+    m = global_metrics().snapshot()["counters"]
+    assert m.get("dispatcher.completed", 0) == 11  # 1 warmup + 10
+    assert m.get("dispatcher.failed", 0) == 0
+
+
+def test_stream_surfaces_stage_error(small_model, devices):
+    """A failing stage must raise, not hang the stream (regression)."""
+    g, variables, plan, x = small_model
+    pipe = LocalPipeline(plan, variables, devices[:3])
+    bad = jnp.ones((2, 5))  # wrong feature dim
+    with pytest.raises(RuntimeError, match="stage 0 failed"):
+        pipe.stream([bad])
+
+
+def test_single_error_budget_allows_retries(small_model, devices):
+    """With max_retries=1 a single transient error must still get one
+    re-dispatch (regression: double-counted retry budget)."""
+    _, variables, plan, x = small_model
+    cfg = ServeConfig(
+        fault=FaultConfig(
+            lease_ttl_s=0.4,
+            heartbeat_s=0.1,
+            task_deadline_s=1.0,
+            watchdog_period_s=0.05,
+            startup_wait_s=2.0,
+            max_retries=1,
+        )
+    )
+    pipe = ServingPipeline(plan, variables, devices[:2], cfg)
+    with pipe:
+        pipe.infer(x)
+        # Inject one transient failure: unconfigure stage 0 on one worker by
+        # submitting a malformed payload through worker 0 directly is messy;
+        # instead kill worker 0 with 'hang' and verify a request that lands
+        # there still completes within a single retry.
+        pipe.kill_worker(0, mode="hang")
+        outs = pipe.stream([x] * 4, timeout_per_request=30.0)
+        assert len(outs) == 4
+
+
+def test_shutdown_fails_pending_futures(small_model, devices):
+    _, variables, plan, x = small_model
+    cfg = ServeConfig(fault=FAST_FAULT)
+    pipe = ServingPipeline(plan, variables, devices[:3], cfg)
+    pipe.start()
+    pipe.infer(x)
+    for w in pipe.workers:
+        w.kill("hang")  # requests will never complete
+    f = pipe.dispatcher.submit(x)
+    pipe.shutdown()
+    t0 = time.monotonic()
+    with pytest.raises(RequestFailed, match="shut down|retries|no live"):
+        f.result(10.0)
+    assert time.monotonic() - t0 < 5.0  # prompt failure, not timeout sleep

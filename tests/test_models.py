@@ -1,0 +1,89 @@
+"""Model zoo tests: shapes, partitionability at the BASELINE cut lists, and
+stage-composition equivalence on small inputs (the SURVEY §4 oracle applied
+to real model graphs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adapt_tpu.graph import partition, valid_cut_points
+from adapt_tpu.models.efficientnet import efficientnet_b0
+from adapt_tpu.models.resnet import RESNET50_3STAGE_CUTS, resnet50
+from adapt_tpu.models.vit import vit_block_cuts, vit_tiny
+
+
+@pytest.fixture(scope="module")
+def small_image():
+    # 64x64 keeps CPU-test conv time low; graphs are resolution-agnostic.
+    return jnp.ones((1, 64, 64, 3), jnp.float32)
+
+
+def test_resnet50_graph_structure():
+    g = resnet50()
+    # 16 blocks -> 16 merge nodes; merges + stem are the valid cuts.
+    cuts = valid_cut_points(g)
+    assert "stem" in cuts
+    assert "conv3_block1_out" in cuts
+    assert "conv3_block1_branch" not in cuts
+    merges = [n for n in g.topo_order() if n.endswith("_out")]
+    assert len(merges) == 16
+
+
+def test_resnet50_partition_and_compose(small_image):
+    g = resnet50(num_classes=10)
+    variables = g.init(jax.random.PRNGKey(0), small_image)
+    y_full = g.apply(variables, small_image)
+    assert y_full.shape == (1, 10)
+    plan = partition(g, list(RESNET50_3STAGE_CUTS))
+    assert plan.num_stages == 3
+    sv = plan.extract_variables(variables)
+    y = plan.compose(sv, small_image)
+    np.testing.assert_array_equal(np.asarray(y_full), np.asarray(y))
+
+
+def test_resnet152_cuts_exist():
+    from adapt_tpu.models.resnet import RESNET152_8STAGE_CUTS, resnet152
+
+    g = resnet152(num_classes=10)
+    plan = partition(g, list(RESNET152_8STAGE_CUTS))
+    assert plan.num_stages == 8
+
+
+def test_vit_tiny_partition_and_compose():
+    g = vit_tiny()
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = g.init(jax.random.PRNGKey(0), x)
+    y_full = g.apply(variables, x)
+    assert y_full.shape == (2, 10)
+    cuts = vit_block_cuts(4, 2)
+    assert cuts == ["encoder_block_1"]
+    plan = partition(g, cuts)
+    sv = plan.extract_variables(variables)
+    np.testing.assert_array_equal(
+        np.asarray(y_full), np.asarray(plan.compose(sv, x))
+    )
+
+
+def test_efficientnet_b0_dag_partition(small_image):
+    g = efficientnet_b0(num_classes=10)
+    variables = g.init(jax.random.PRNGKey(1), small_image)
+    y_full = g.apply(variables, small_image)
+    assert y_full.shape == (1, 10)
+    # Multi-branch DAG: identity-residual blocks create joins; partition at
+    # a couple of add-merge points.
+    cuts = [c for c in valid_cut_points(g) if c.endswith("_add")]
+    assert len(cuts) >= 4  # several residual merges exist
+    plan = partition(g, cuts[:2])
+    sv = plan.extract_variables(variables)
+    np.testing.assert_array_equal(
+        np.asarray(y_full), np.asarray(plan.compose(sv, small_image))
+    )
+
+
+def test_bfloat16_resnet(small_image):
+    g = resnet50(num_classes=10, dtype=jnp.bfloat16)
+    variables = g.init(jax.random.PRNGKey(0), small_image)
+    y = g.apply(variables, small_image)
+    assert y.dtype == jnp.float32  # head casts logits back to f32
+    assert np.isfinite(np.asarray(y)).all()
