@@ -1,0 +1,4 @@
+from adapt_tpu.comm.codec import CODECS, Codec, get_codec
+from adapt_tpu.comm.framing import recv_msg, send_msg
+
+__all__ = ["CODECS", "Codec", "get_codec", "send_msg", "recv_msg"]

@@ -1,0 +1,77 @@
+"""Wire framing for cross-host messages.
+
+Capability parity with the reference's hand-rolled protocol
+(``/root/reference/src/node_state.py:39-161``): length-prefixed framing
+(there: 8-byte big-endian length + chunked non-blocking sends with a
+``select`` spin; here: the same 8-byte BE length prefix over blocking
+sockets with ``sendall`` — the chunk/spin loop is an artifact of
+non-blocking sockets the design doesn't need) and a fixed routing header
+(there: a 4-byte partition index, ``src/dispatcher.py:209-213``; here: a
+typed header carrying message type, stage index, request id and attempt so
+re-dispatch and exactly-once work across hosts too).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from dataclasses import dataclass
+
+#: msg types (reference: implied by port number — 6000 data / 6001 config /
+#: 6003 results; here: explicit enum in-band on one port).
+MSG_DATA = 1
+MSG_CONFIG = 2
+MSG_RESULT = 3
+MSG_ACK = 4
+MSG_ERROR = 5
+
+#: header: type, stage_index, request_id, attempt
+_HEADER = struct.Struct(">BIQI")
+_LEN = struct.Struct(">Q")
+
+#: The reference's ACK byte (src/dispatcher.py:250-260, src/node.py:52,88).
+ACK_BYTE = b"\x06"
+
+
+@dataclass(frozen=True)
+class Message:
+    msg_type: int
+    stage_index: int
+    request_id: int
+    attempt: int
+    payload: bytes
+
+
+def send_msg(sock: socket.socket, msg: Message) -> None:
+    header = _HEADER.pack(
+        msg.msg_type, msg.stage_index, msg.request_id, msg.attempt
+    )
+    sock.sendall(_LEN.pack(len(header) + len(msg.payload)) + header + msg.payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> Message:
+    (total,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if total < _HEADER.size:
+        raise ConnectionError(f"short frame: {total}")
+    buf = _recv_exact(sock, total)
+    msg_type, stage_index, request_id, attempt = _HEADER.unpack(
+        buf[: _HEADER.size]
+    )
+    return Message(
+        msg_type=msg_type,
+        stage_index=stage_index,
+        request_id=request_id,
+        attempt=attempt,
+        payload=buf[_HEADER.size :],
+    )

@@ -1,0 +1,436 @@
+"""Cross-host workers: a stage server process + a dispatcher-side proxy.
+
+This is the multi-machine path of the reference, rebuilt: a worker process
+(reference: ``python -m src.node``, ``/root/reference/src/node.py:210-211``)
+serves stage configuration and data over TCP (there: four ports with
+implicit message types, ``src/node.py:19-22``; here: one duplex connection
+with typed frames, ``comm.framing``), and the dispatcher drives it through
+``RemoteWorkerProxy`` — the same interface as the in-process
+``StageWorker``, so the control plane (late binding, watchdog, re-dispatch)
+is topology-blind.
+
+Configuration transfers the model by *name + cut list + weights* (the
+worker rebuilds the graph from the shared model registry and loads
+flax-serialized weights), the TPU-native analog of the reference shipping
+Keras architecture JSON + weight arrays (``src/dispatcher.py:223-264``,
+``src/node.py:40-45``). Activations cross with a configurable codec
+(``comm.codec``) — the zfp+lz4-at-DCN-boundaries design of SURVEY §2.3.
+
+Heartbeats ride the same connection as typed ping frames; the proxy renews
+the worker's registry lease only when pings arrive, so a dead process or a
+cut link expires the lease exactly like a crashed in-process worker.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+from adapt_tpu.comm import codec as codec_lib
+from adapt_tpu.comm.framing import (
+    MSG_ACK,
+    MSG_CONFIG,
+    MSG_DATA,
+    MSG_ERROR,
+    MSG_RESULT,
+    Message,
+    recv_msg,
+    send_msg,
+)
+from adapt_tpu.config import FaultConfig
+from adapt_tpu.control.registry import WorkerRegistry
+from adapt_tpu.control.worker import TaskResult, WorkerState
+from adapt_tpu.utils.logging import get_logger
+
+log = get_logger("remote")
+
+MSG_KILL = 6  # chaos hook for fault-injection tests
+MSG_PING = 7
+MSG_CONFIG_ERR = 8
+
+
+# --------------------------------------------------------------------------
+# Worker-process side
+# --------------------------------------------------------------------------
+
+
+class RemoteStageServer:
+    """Serves stage configure/execute for one device over one TCP port."""
+
+    def __init__(
+        self,
+        port: int,
+        device_index: int = 0,
+        heartbeat_s: float = 0.5,
+        host: str = "127.0.0.1",
+    ):
+        self.port = port
+        self.host = host
+        self.device = jax.devices()[device_index]
+        self.heartbeat_s = heartbeat_s
+        self._graph_cache: dict[str, Any] = {}
+        self._stages: dict[int, tuple[Any, Any]] = {}  # idx -> (fn, vars)
+        self._codec: codec_lib.Codec = codec_lib.get_codec("none")
+        self._hung = False
+        self._crashed = False
+
+    def _build_stage(self, cfg: dict, weights: bytes):
+        """Rebuild the named model, slice it, and load the stage weights."""
+        from flax import serialization
+
+        from adapt_tpu.graph.partition import partition
+        from adapt_tpu.models import MODEL_REGISTRY
+
+        key = json.dumps(
+            [cfg["model"], cfg.get("num_classes", 1000), cfg["cuts"]],
+            sort_keys=True,
+        )
+        if key not in self._graph_cache:
+            factory, default_shape = MODEL_REGISTRY[cfg["model"]]
+            graph = factory(num_classes=cfg.get("num_classes", 1000))
+            plan = partition(graph, cfg["cuts"])
+            input_shape = cfg.get("input_shape") or [1, *default_shape]
+            template = jax.eval_shape(
+                graph.init,
+                jax.random.PRNGKey(0),
+                jax.ShapeDtypeStruct(tuple(input_shape), jax.numpy.float32),
+            )
+            self._graph_cache[key] = (plan, template)
+        plan, template = self._graph_cache[key]
+        idx = cfg["stage_index"]
+        if not 0 <= idx < plan.num_stages:
+            raise ValueError(
+                f"stage index {idx} out of range (plan has "
+                f"{plan.num_stages} stages)"
+            )
+        spec = plan.stages[idx]
+        stage_template = {n: template[n] for n in spec.node_names}
+        variables = serialization.from_bytes(stage_template, weights)
+        variables = jax.device_put(variables, self.device)
+        jax.block_until_ready(variables)
+        fn = jax.jit(plan.stage_apply(spec))
+        self._stages[idx] = (fn, variables)
+        self._codec = codec_lib.get_codec(cfg.get("codec", "none"))
+
+    def _handle(self, conn: socket.socket) -> None:
+        stop_ping = threading.Event()
+
+        def ping_loop():
+            while not stop_ping.wait(self.heartbeat_s):
+                if self._crashed:
+                    return
+                try:
+                    send_msg(conn, Message(MSG_PING, 0, 0, 0, b""))
+                except OSError:
+                    return
+
+        threading.Thread(target=ping_loop, daemon=True).start()
+        try:
+            while not self._crashed:
+                msg = recv_msg(conn)
+                if msg.msg_type == MSG_CONFIG:
+                    hlen = int.from_bytes(msg.payload[:4], "big")
+                    cfg = json.loads(msg.payload[4 : 4 + hlen].decode())
+                    weights = msg.payload[4 + hlen :]
+                    try:
+                        self._build_stage(cfg, weights)
+                        send_msg(
+                            conn,
+                            Message(MSG_ACK, msg.stage_index, 0, 0, b""),
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        log.error("remote configure failed: %s", e)
+                        send_msg(
+                            conn,
+                            Message(
+                                MSG_CONFIG_ERR,
+                                msg.stage_index,
+                                0,
+                                0,
+                                str(e).encode(),
+                            ),
+                        )
+                elif msg.msg_type == MSG_DATA:
+                    if self._hung:
+                        continue  # swallow; watchdog must recover
+                    self._execute(conn, msg)
+                elif msg.msg_type == MSG_KILL:
+                    mode = msg.payload.decode()
+                    log.warning("remote worker kill: %s", mode)
+                    if mode == "hang":
+                        self._hung = True
+                    else:
+                        self._crashed = True
+                        break
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            stop_ping.set()
+            conn.close()
+
+    def _execute(self, conn: socket.socket, msg: Message) -> None:
+        try:
+            entry = self._stages.get(msg.stage_index)
+            if entry is None:
+                raise RuntimeError(f"stage {msg.stage_index} not configured")
+            fn, variables = entry
+            x = codec_lib.unpack(msg.payload)
+            y = fn(variables, jax.device_put(x, self.device))
+            y.block_until_ready()
+            out = codec_lib.pack(self._codec, np.asarray(y))
+            send_msg(
+                conn,
+                Message(
+                    MSG_RESULT, msg.stage_index, msg.request_id, msg.attempt, out
+                ),
+            )
+        except Exception as e:  # noqa: BLE001
+            send_msg(
+                conn,
+                Message(
+                    MSG_ERROR,
+                    msg.stage_index,
+                    msg.request_id,
+                    msg.attempt,
+                    str(e).encode(),
+                ),
+            )
+
+    def serve_forever(self) -> None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self.host, self.port))
+        srv.listen(4)
+        log.info("remote stage server on %s:%d", self.host, self.port)
+        while not self._crashed:
+            try:
+                srv.settimeout(0.5)
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._handle(conn)
+        srv.close()
+
+
+# --------------------------------------------------------------------------
+# Dispatcher side
+# --------------------------------------------------------------------------
+
+
+class RemoteWorkerProxy:
+    """Drives a RemoteStageServer; presents the StageWorker interface."""
+
+    def __init__(
+        self,
+        worker_id: str,
+        address: tuple[str, int],
+        registry: WorkerRegistry,
+        result_queue,
+        model_config: dict,
+        codec_name: str = "none",
+        fault: FaultConfig | None = None,
+    ):
+        self.worker_id = worker_id
+        self.address = address
+        self._registry = registry
+        self._results = result_queue
+        self._fault = fault or FaultConfig()
+        self._model_config = model_config
+        self._codec = codec_lib.get_codec(codec_name)
+        self._codec_name = codec_name
+        self._sock: socket.socket | None = None
+        self._send_lock = threading.Lock()
+        self._configured: set[int] = set()
+        self._config_acks: dict[int, threading.Event] = {}
+        self._config_errors: dict[int, str] = {}
+        self._inflight_count = 0
+        self._count_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._reader: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "RemoteWorkerProxy":
+        deadline = time.monotonic() + self._fault.startup_wait_s
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            try:
+                self._sock = socket.create_connection(self.address, timeout=5.0)
+                self._sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+                break
+            except OSError as e:
+                last = e
+                time.sleep(0.1)
+        if self._sock is None:
+            raise ConnectionError(
+                f"cannot reach remote worker at {self.address}: {last}"
+            )
+        self._registry.register(
+            self.worker_id,
+            meta={"address": f"{self.address[0]}:{self.address[1]}"},
+            ttl_s=self._fault.lease_ttl_s,
+        )
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"{self.worker_id}-reader", daemon=True
+        )
+        self._reader.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self._reader is not None:
+            self._reader.join(timeout=2.0)
+        self._registry.deregister(self.worker_id)
+
+    # -- StageWorker interface ----------------------------------------------
+
+    @property
+    def state(self) -> WorkerState:
+        if self._stop.is_set():
+            return WorkerState.DEAD
+        with self._count_lock:
+            return (
+                WorkerState.BUSY if self._inflight_count else WorkerState.IDLE
+            )
+
+    @property
+    def queue_depth(self) -> int:
+        with self._count_lock:
+            return self._inflight_count
+
+    def is_configured(self, stage_index: int) -> bool:
+        return stage_index in self._configured
+
+    def configure(self, stage_index: int, fn, host_variables, spec=None) -> None:
+        """Ship (model name, cuts, stage index, weights) and wait for ACK.
+        ``fn`` is ignored — the remote compiles its own stage program."""
+        from flax import serialization
+
+        del fn, spec
+        header = json.dumps(
+            {
+                **self._model_config,
+                "stage_index": stage_index,
+                "codec": self._codec_name,
+            }
+        ).encode()
+        weights = serialization.to_bytes(host_variables)
+        payload = len(header).to_bytes(4, "big") + header + weights
+        ack = threading.Event()
+        self._config_acks[stage_index] = ack
+        with self._send_lock:
+            send_msg(
+                self._sock, Message(MSG_CONFIG, stage_index, 0, 0, payload)
+            )
+        if not ack.wait(self._fault.configure_timeout_s):
+            raise TimeoutError(
+                f"no config ACK for stage {stage_index} from "
+                f"{self.worker_id}"
+            )
+        err = self._config_errors.pop(stage_index, None)
+        if err is not None:
+            raise RuntimeError(f"remote configure failed: {err}")
+        self._configured.add(stage_index)
+
+    def submit(self, task) -> None:
+        payload = codec_lib.pack(self._codec, np.asarray(task.payload))
+        with self._count_lock:
+            self._inflight_count += 1
+        with self._send_lock:
+            send_msg(
+                self._sock,
+                Message(
+                    MSG_DATA,
+                    task.stage_index,
+                    task.request_id,
+                    task.attempt,
+                    payload,
+                ),
+            )
+
+    def kill(self, mode: str = "crash") -> None:
+        with self._send_lock:
+            send_msg(self._sock, Message(MSG_KILL, 0, 0, 0, mode.encode()))
+
+    # -- internals -----------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                msg = recv_msg(self._sock)
+            except (ConnectionError, OSError):
+                break
+            if msg.msg_type == MSG_PING:
+                self._registry.heartbeat(
+                    self.worker_id, ttl_s=self._fault.lease_ttl_s
+                )
+            elif msg.msg_type == MSG_ACK:
+                ev = self._config_acks.get(msg.stage_index)
+                if ev is not None:
+                    ev.set()
+            elif msg.msg_type == MSG_CONFIG_ERR:
+                self._config_errors[msg.stage_index] = msg.payload.decode()
+                ev = self._config_acks.get(msg.stage_index)
+                if ev is not None:
+                    ev.set()
+            elif msg.msg_type in (MSG_RESULT, MSG_ERROR):
+                with self._count_lock:
+                    self._inflight_count = max(0, self._inflight_count - 1)
+                if msg.msg_type == MSG_RESULT:
+                    self._results.put(
+                        TaskResult(
+                            request_id=msg.request_id,
+                            stage_index=msg.stage_index,
+                            attempt=msg.attempt,
+                            worker_id=self.worker_id,
+                            output=codec_lib.unpack(msg.payload),
+                        )
+                    )
+                else:
+                    self._results.put(
+                        TaskResult(
+                            request_id=msg.request_id,
+                            stage_index=msg.stage_index,
+                            attempt=msg.attempt,
+                            worker_id=self.worker_id,
+                            error=msg.payload.decode(),
+                        )
+                    )
+        # Socket gone: stop renewing the lease; the reaper will evict us.
+
+
+def main() -> None:
+    """CLI entry: ``python -m adapt_tpu.comm.remote --port 7001``
+    (the reference's ``python -m src.node``, README.md:44)."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--device-index", type=int, default=0)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--heartbeat", type=float, default=0.5)
+    args = p.parse_args()
+    RemoteStageServer(
+        args.port,
+        device_index=args.device_index,
+        heartbeat_s=args.heartbeat,
+        host=args.host,
+    ).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

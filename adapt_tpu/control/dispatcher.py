@@ -24,6 +24,7 @@ import itertools
 import queue
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -119,6 +120,13 @@ class Dispatcher:
         self._sem = threading.Semaphore(self.config.max_inflight)
         self._req_ids = itertools.count()
         self._watchdog_paused = False
+        # Forward/re-dispatch pool: _acquire can block on a weight transfer
+        # (configure), which must never stall the result loop or the
+        # registry reaper (the reference likewise forwards in spawned
+        # threads, src/dispatcher.py:137-144).
+        self._forward_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="dispatcher-forward"
+        )
         self._shutdown = threading.Event()
         self._threads: list[threading.Thread] = []
         self._started = False
@@ -185,6 +193,7 @@ class Dispatcher:
             t.join(timeout=2.0)
         # Fail outstanding futures promptly instead of letting callers
         # sleep out their timeouts.
+        self._forward_pool.shutdown(wait=False, cancel_futures=True)
         with self._inflight_lock:
             abandoned = list(self._inflight.values())
             self._inflight.clear()
@@ -345,6 +354,21 @@ class Dispatcher:
         )
         global_metrics().inc("dispatcher.tasks_sent")
 
+    def _forward(self, result: TaskResult, entry: _Inflight, next_stage: int) -> None:
+        """Forward a stage output to the next stage (runs on the forward
+        pool; _acquire may block on a configure)."""
+        try:
+            self._dispatch(
+                result.request_id,
+                next_stage,
+                result.output,
+                entry.future,
+                attempt=0,
+                retries=0,
+            )
+        except Exception as e:  # noqa: BLE001
+            self._finish(entry.future, error=str(e))
+
     def _redispatch(self, entry: _Inflight, reason: str) -> None:
         """Watchdog / failure path: re-send the retained payload to a
         different worker (reference watchdog intent, ``src/dispatcher.py:
@@ -413,21 +437,15 @@ class Dispatcher:
                     continue
                 del self._inflight[result.request_id]
             if result.error is not None:
-                self._redispatch(entry, reason=f"error: {result.error}")
+                self._forward_pool.submit(
+                    self._redispatch, entry, f"error: {result.error}"
+                )
                 continue
             next_stage = result.stage_index + 1
             if next_stage < self.plan.num_stages:
-                try:
-                    self._dispatch(
-                        result.request_id,
-                        next_stage,
-                        result.output,
-                        entry.future,
-                        attempt=0,
-                        retries=0,
-                    )
-                except Exception as e:
-                    self._finish(entry.future, error=str(e))
+                self._forward_pool.submit(
+                    self._forward, result, entry, next_stage
+                )
             else:
                 self._finish(entry.future, value=result.output)
             stage_latency = time.monotonic() - entry.start_time
@@ -452,7 +470,9 @@ class Dispatcher:
                         overdue.append(entry)
                         del self._inflight[rid]
             for entry in overdue:
-                self._redispatch(entry, reason="deadline exceeded")
+                self._forward_pool.submit(
+                    self._redispatch, entry, "deadline exceeded"
+                )
 
     def _on_membership(self, event: str, worker_id: str) -> None:
         """Reference ``_worker_monitor`` (:276): on worker death, don't wait
@@ -466,4 +486,6 @@ class Dispatcher:
             for e in orphaned:
                 del self._inflight[e.request_id]
         for e in orphaned:
-            self._redispatch(e, reason=f"worker {worker_id} left")
+            self._forward_pool.submit(
+                self._redispatch, e, f"worker {worker_id} left"
+            )

@@ -130,13 +130,15 @@ class StageWorker:
             self._crashed.set()
             self._inbox.put(None)
             log.warning("worker %s crashed (injected)", self.worker_id)
+            with self._state_lock:
+                self._state = WorkerState.DEAD
         elif mode == "hang":
+            # A real hang keeps heartbeating and stays schedulable — the
+            # dispatcher must discover it via task deadlines, not state.
             self._hung.set()
             log.warning("worker %s hung (injected)", self.worker_id)
         else:
             raise ValueError(f"unknown kill mode {mode!r}")
-        with self._state_lock:
-            self._state = WorkerState.DEAD
 
     # -- dispatcher-facing API ----------------------------------------------
 
@@ -177,9 +179,18 @@ class StageWorker:
         # A crashed worker stops renewing; the registry reaper evicts it
         # after lease_ttl (reference: etcd lease expiry on /workers/<ip>).
         while not self._crashed.wait(self._fault.heartbeat_s):
-            self._registry.heartbeat(
+            renewed = self._registry.heartbeat(
                 self.worker_id, ttl_s=self._fault.lease_ttl_s
             )
+            if not renewed:
+                # Lease lapsed (e.g. a long compile stalled this thread)
+                # but we are alive: re-register rather than serve forever
+                # while invisible to the scheduler.
+                self._registry.register(
+                    self.worker_id,
+                    meta={"device": str(self.device)},
+                    ttl_s=self._fault.lease_ttl_s,
+                )
 
     def _exec_loop(self) -> None:
         while not self._crashed.is_set():

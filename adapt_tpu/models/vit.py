@@ -123,5 +123,14 @@ def vit_block_cuts(depth: int, num_stages: int) -> list[str]:
     """Evenly split ``depth`` encoder blocks into ``num_stages`` stages."""
     if num_stages < 2:
         return []
-    bounds = [round(k * depth / num_stages) for k in range(1, num_stages)]
+    if num_stages > depth:
+        raise ValueError(
+            f"cannot split {depth} encoder blocks into {num_stages} stages"
+        )
+    bounds = []
+    for k in range(1, num_stages):
+        b = max(1, round(k * depth / num_stages))
+        if bounds and b <= bounds[-1]:  # guard banker's-rounding collisions
+            b = bounds[-1] + 1
+        bounds.append(b)
     return [f"encoder_block_{b - 1}" for b in bounds]

@@ -1,0 +1,18 @@
+from adapt_tpu.parallel.pipeline_spmd import spmd_pipeline, stack_stage_params
+from adapt_tpu.parallel.ring_attention import ring_attention
+from adapt_tpu.parallel.sharding import (
+    batch_sharding,
+    replicate,
+    shard_batch,
+    vit_tp_rules,
+)
+
+__all__ = [
+    "spmd_pipeline",
+    "stack_stage_params",
+    "ring_attention",
+    "batch_sharding",
+    "replicate",
+    "shard_batch",
+    "vit_tp_rules",
+]

@@ -1,0 +1,143 @@
+"""SPMD pipeline parallelism: one XLA program, activations on ICI.
+
+The reference's pipeline is MPMD over TCP — one process per stage, framed
+sockets between them (SURVEY.md §2.3). On TPU the idiomatic equivalent for
+*homogeneous* stages (transformer blocks) is a single SPMD program: stack
+the L identical blocks' params with leading dim L, shard that dim over the
+``pp`` mesh axis (each device holds L/P consecutive blocks), and run the
+GPipe-style schedule as a ``lax.scan`` whose per-step activation hand-off
+is a ``lax.ppermute`` — compiled by XLA onto ICI with no host round-trips,
+no framing, no codec (the design SURVEY §2.3 calls for).
+
+Heterogeneous-stage models (ResNet/EfficientNet) use the MPMD path
+(``runtime.LocalPipeline`` / the adaptive dispatcher); this module is the
+throughput path for block-structured transformers, and it composes with
+``dp`` (batch axis) in the same mesh — and it is differentiable, so the
+same schedule backs pipelined training steps.
+
+Schedule (M microbatches, P pipeline ranks, T = M+P-1 ticks): at tick t,
+rank p runs microbatch ``t-p`` through its block slice; rank 0 injects
+``xs[t]``, rank P-1 writes finished microbatches into the output buffer.
+Invalid (bubble) ticks compute on garbage and are masked out of the output.
+Utilization is M/(M+P-1) — choose M >= 2P.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_stage_params(per_block_variables: list[Any]) -> Any:
+    """Stack identical-structure per-block param pytrees along a new leading
+    axis (the pipeline-shardable layout)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *per_block_variables)
+
+
+def spmd_pipeline(
+    block_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    xs: jax.Array,
+    mesh: Mesh,
+    axis: str = "pp",
+    batch_axis: str | None = None,
+) -> jax.Array:
+    """Run ``xs`` (shape [M, mb, ...]) through L stacked blocks pipelined
+    over the ``axis`` dimension of ``mesh``.
+
+    ``block_fn(params_i, x) -> y`` applies ONE block (y.shape == x.shape).
+    ``stacked_params`` leaves have leading dim L with L % P == 0.
+    If ``batch_axis`` is given, the microbatch batch dim (dim 1 of xs) is
+    additionally sharded over it (dp x pp in one program).
+    """
+    num_ranks = mesh.shape[axis]
+    num_micro = xs.shape[0]
+    lead = jax.tree.leaves(stacked_params)[0].shape[0]
+    if lead % num_ranks:
+        raise ValueError(
+            f"stacked block count {lead} not divisible by pipeline ranks "
+            f"{num_ranks}"
+        )
+
+    def local_stack(params_local, h):
+        def body(carry, p):
+            return block_fn(p, carry), None
+
+        h, _ = lax.scan(body, h, params_local)
+        return h
+
+    param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
+    x_spec = (
+        P(None, batch_axis) if batch_axis is not None else P()
+    )
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+    )
+    def pipelined(params_local, xs_local):
+        rank = lax.axis_index(axis)
+        ticks = num_micro + num_ranks - 1
+        mb_shape = xs_local.shape[1:]
+        shift = [(i, i + 1) for i in range(num_ranks - 1)]
+
+        def step(carry, t):
+            prev_y, outputs = carry
+            # Hand the previous tick's output to the next rank (ICI hop).
+            recv = lax.ppermute(prev_y, axis, shift)
+            inject = lax.dynamic_index_in_dim(
+                xs_local, jnp.clip(t, 0, num_micro - 1), 0, keepdims=False
+            )
+            h = jnp.where(rank == 0, inject, recv)
+            y = local_stack(params_local, h)
+            m = t - rank
+            is_last = rank == num_ranks - 1
+            valid = jnp.logical_and(m >= 0, m < num_micro)
+            write = jnp.logical_and(is_last, valid)
+            updated = lax.dynamic_update_index_in_dim(
+                outputs,
+                y.astype(outputs.dtype),
+                jnp.clip(m, 0, num_micro - 1),
+                0,
+            )
+            outputs = jnp.where(write, updated, outputs)
+            return (y, outputs), None
+
+        vary_axes = (axis,) + ((batch_axis,) if batch_axis else ())
+        init = lax.pcast(
+            (
+                jnp.zeros(mb_shape, xs_local.dtype),
+                jnp.zeros((num_micro, *mb_shape), xs_local.dtype),
+            ),
+            vary_axes,
+            to="varying",
+        )
+        (_, outputs), _ = lax.scan(step, init, jnp.arange(ticks))
+        # Only the last rank holds real outputs; replicate over the pipeline
+        # axis (zeros elsewhere make psum a broadcast of rank P-1's buffer).
+        return lax.psum(outputs, axis)
+
+    return pipelined(stacked_params, xs)
+
+
+def pipeline_microbatch(
+    x: jax.Array, num_micro: int
+) -> jax.Array:
+    """[B, ...] -> [M, B/M, ...] microbatch split."""
+    if x.shape[0] % num_micro:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by {num_micro} microbatches"
+        )
+    return x.reshape(num_micro, x.shape[0] // num_micro, *x.shape[1:])
+
+
+def pipeline_unmicrobatch(y: jax.Array) -> jax.Array:
+    """[M, mb, ...] -> [B, ...]."""
+    return y.reshape(y.shape[0] * y.shape[1], *y.shape[2:])

@@ -1,0 +1,125 @@
+"""Ring attention: sequence parallelism for long contexts.
+
+Not in the reference (SURVEY.md §2.2: no attention at all), but first-class
+here: sequences too long for one chip's HBM are sharded over an ``sp`` mesh
+axis; each device holds a [S/P] slice of Q, K, V. K/V blocks rotate around
+the ring via ``lax.ppermute`` (ICI neighbor hops) while each device
+accumulates its Q-block's attention with the streaming (online-softmax)
+update, so the full S x S score matrix never materializes — compute stays
+flash-style blockwise and memory per chip is O(S/P).
+
+The accumulator update is the standard two-pass-free softmax: carrying
+running max ``m``, normalizer ``l``, and unnormalized output ``o``; each
+incoming K/V block rescales the accumulators by ``exp(m - m_new)``.
+Causal masking uses *global* positions recovered from ring step and rank,
+so the result matches single-device causal attention exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _attention_block(q, k, v, mask, m, l, o):
+    """One online-softmax accumulation step.
+
+    q: [B, H, Sq, D]; k, v: [B, H, Skv, D]; mask: [Sq, Skv] additive.
+    m, l: [B, H, Sq, 1]; o: [B, H, Sq, D].
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale + mask
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    o_new = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = False,
+) -> jax.Array:
+    """Sequence-parallel attention over ``axis``.
+
+    q, k, v: [B, H, S, D] with S divisible by the axis size; inputs/outputs
+    are sharded on the S dimension over ``axis`` (pass global arrays under
+    jit; GSPMD splits them per the shard_map specs).
+    """
+    num_ranks = mesh.shape[axis]
+    seq = q.shape[2]
+    if seq % num_ranks:
+        raise ValueError(f"sequence {seq} not divisible by ring size {num_ranks}")
+    s_local = seq // num_ranks
+    ring = [(i, (i + 1) % num_ranks) for i in range(num_ranks)]
+
+    spec = P(None, None, axis, None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    def ringed(q_l, k_l, v_l):
+        rank = lax.axis_index(axis)
+        b, h, sq, d = q_l.shape
+        q_pos = rank * s_local + jnp.arange(s_local)
+
+        def step(carry, i):
+            m, l, o, k_cur, v_cur = carry
+            # After i hops of forward rotation, this rank holds the K/V
+            # block that originated at rank - i (mod P).
+            src = jnp.mod(rank - i, num_ranks)
+            kv_pos = src * s_local + jnp.arange(s_local)
+            if causal:
+                mask = jnp.where(
+                    q_pos[:, None] >= kv_pos[None, :], 0.0, _NEG_INF
+                ).astype(q_l.dtype)
+            else:
+                mask = jnp.zeros((s_local, s_local), q_l.dtype)
+            m, l, o = _attention_block(q_l, k_cur, v_cur, mask, m, l, o)
+            k_nxt = lax.ppermute(k_cur, axis, ring)
+            v_nxt = lax.ppermute(v_cur, axis, ring)
+            return (m, l, o, k_nxt, v_nxt), None
+
+        init = (
+            *lax.pcast(
+                (
+                    jnp.full((b, h, sq, 1), _NEG_INF, q_l.dtype),
+                    jnp.zeros((b, h, sq, 1), q_l.dtype),
+                    jnp.zeros((b, h, sq, d), q_l.dtype),
+                ),
+                (axis,),
+                to="varying",
+            ),
+            k_l,
+            v_l,
+        )
+        (m, l, o, _, _), _ = lax.scan(step, init, jnp.arange(num_ranks))
+        return o / jnp.maximum(l, 1e-20)
+
+    return ringed(q, k, v)
+
+
+def full_attention(q, k, v, causal: bool = False) -> jax.Array:
+    """Single-device reference implementation (the correctness oracle)."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        seq_q, seq_k = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((seq_q, seq_k), bool))
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)

@@ -1,0 +1,68 @@
+"""Sharding helpers: NamedShardings and param-placement rules.
+
+The reference has no intra-model parallelism at all (SURVEY.md §2.2: PP
+only). TPU-native, DP/TP are nearly free via GSPMD: annotate batch and
+weight shardings over a mesh and let XLA insert the collectives (the
+scaling-book recipe). These helpers centralize the annotations.
+"""
+
+from __future__ import annotations
+
+import re
+from collections.abc import Mapping
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Shard the leading (batch) dimension over ``axis``."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch(x: jax.Array, mesh: Mesh, axis: str = "dp") -> jax.Array:
+    return jax.device_put(x, batch_sharding(mesh, axis))
+
+
+def replicate(tree, mesh: Mesh):
+    """Fully replicate a pytree over the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+#: Tensor-parallel placement rules for the ViT encoder blocks
+#: (``models/vit.py``): megatron-style — qkv/mlp-in column-split over 'tp',
+#: attn-out/mlp-out row-split, so each block needs exactly one psum pair
+#: (inserted automatically by GSPMD).
+_VIT_TP_PATTERNS: list[tuple[str, tuple]] = [
+    (r"encoder_block.*(query|key|value).*kernel", (None, None, "tp")),
+    (r"encoder_block.*(query|key|value).*bias", (None, "tp")),
+    (r"encoder_block.*out.*kernel", ("tp", None, None)),
+    (r"encoder_block.*Dense_0.*kernel", (None, "tp")),  # mlp in
+    (r"encoder_block.*Dense_0.*bias", ("tp",)),
+    (r"encoder_block.*Dense_1.*kernel", ("tp", None)),  # mlp out
+]
+
+
+def vit_tp_rules(path: str, value_ndim: int) -> P:
+    """Map a flattened param path to its TP PartitionSpec (default:
+    replicated)."""
+    for pattern, spec in _VIT_TP_PATTERNS:
+        if re.fullmatch(pattern, path):
+            if len(spec) == value_ndim:
+                return P(*spec)
+    return P()
+
+
+def tree_shardings(
+    variables: Mapping, mesh: Mesh, rules=vit_tp_rules
+) -> Mapping:
+    """Build a NamedSharding pytree from path-based rules."""
+
+    def assign(path, leaf):
+        path_str = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        )
+        return NamedSharding(mesh, rules(path_str, leaf.ndim))
+
+    return jax.tree_util.tree_map_with_path(assign, variables)

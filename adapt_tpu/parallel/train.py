@@ -1,0 +1,104 @@
+"""Distributed training step over a device mesh (dp x pp [+ tp/sp]).
+
+Parity scope is inference-only (SURVEY.md §2.8: the reference has no
+training path), but the SPMD machinery (``spmd_pipeline`` is
+differentiable; GSPMD handles dp/tp) makes a mesh-sharded training step
+nearly free, and it is the canonical proof that the multi-chip sharding
+design is real: batch over ``dp``, stacked transformer blocks over ``pp``,
+grads reduced by XLA-inserted collectives, optax update applied under the
+same shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from adapt_tpu.parallel.pipeline_spmd import (
+    pipeline_microbatch,
+    pipeline_unmicrobatch,
+    spmd_pipeline,
+)
+
+
+class PipelinedViT(NamedTuple):
+    """ViT params split into pipeline-stacked blocks + replicated ends."""
+
+    embed: Any  # patch_embed variables (replicated)
+    blocks: Any  # stacked encoder block variables, leading dim L (pp-sharded)
+    head: Any  # classifier variables (replicated)
+
+
+def split_vit_variables(graph, variables, depth: int) -> PipelinedViT:
+    """Reshape a ``models.vit`` LayerGraph's variables into the pipelined
+    layout (stack the homogeneous encoder blocks)."""
+    from adapt_tpu.parallel.pipeline_spmd import stack_stage_params
+
+    blocks = stack_stage_params(
+        [variables[f"encoder_block_{i}"] for i in range(depth)]
+    )
+    return PipelinedViT(
+        embed=variables["patch_embed"],
+        blocks=blocks,
+        head=variables["head"],
+    )
+
+
+def vit_shardings(params: PipelinedViT, mesh: Mesh) -> PipelinedViT:
+    """NamedShardings for the pipelined layout: blocks pp-sharded on the
+    stack dim, ends replicated."""
+    return PipelinedViT(
+        embed=jax.tree.map(
+            lambda _: NamedSharding(mesh, P()), params.embed
+        ),
+        blocks=jax.tree.map(
+            lambda _: NamedSharding(mesh, P("pp")), params.blocks
+        ),
+        head=jax.tree.map(lambda _: NamedSharding(mesh, P()), params.head),
+    )
+
+
+def make_pipelined_vit_apply(graph, mesh: Mesh, num_micro: int):
+    """Forward: embed -> pp-pipelined blocks -> head, one XLA program."""
+    embed_mod = graph.node("patch_embed").module
+    block_mod = graph.node("encoder_block_0").module
+    head_mod = graph.node("head").module
+
+    def apply_fn(params: PipelinedViT, x: jax.Array) -> jax.Array:
+        h = embed_mod.apply(params.embed, x)
+        xs = pipeline_microbatch(h, num_micro)
+        ys = spmd_pipeline(
+            lambda p, a: block_mod.apply(p, a),
+            params.blocks,
+            xs,
+            mesh,
+            axis="pp",
+            batch_axis="dp" if "dp" in mesh.axis_names else None,
+        )
+        h = pipeline_unmicrobatch(ys)
+        return head_mod.apply(params.head, h)
+
+    return apply_fn
+
+
+def make_train_step(apply_fn, optimizer: optax.GradientTransformation):
+    """(params, opt_state, x, y) -> (params, opt_state, loss), jittable
+    over the mesh; XLA inserts the dp grad reduction from the shardings."""
+
+    def loss_fn(params, x, y):
+        logits = apply_fn(params, x)
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        )
+
+    def train_step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step

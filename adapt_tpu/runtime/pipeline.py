@@ -76,20 +76,39 @@ class LocalPipeline:
         n_stages = len(self.stages)
         qs: list[queue.Queue] = [queue.Queue(maxsize=4) for _ in range(n_stages + 1)]
         outputs: list[jax.Array] = []
+        abort = threading.Event()
+
+        def put_or_abort(q: queue.Queue, item) -> bool:
+            while not abort.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def get_or_abort(q: queue.Queue):
+            while not abort.is_set():
+                try:
+                    return q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+            return _SENTINEL
 
         def stage_loop(i: int):
             stage = self.stages[i]
             while True:
-                item = qs[i].get()
+                item = get_or_abort(qs[i])
                 if item is _SENTINEL or isinstance(item, _StageError):
-                    qs[i + 1].put(item)  # propagate shutdown/error downstream
+                    put_or_abort(qs[i + 1], item)
                     break
                 try:
                     y = stage(item)
                 except Exception as e:  # noqa: BLE001 — surface to caller
-                    qs[i + 1].put(_StageError(stage.spec.index, e))
+                    put_or_abort(qs[i + 1], _StageError(stage.spec.index, e))
                     break
-                qs[i + 1].put(y)
+                if not put_or_abort(qs[i + 1], y):
+                    break
 
         threads = [
             threading.Thread(target=stage_loop, args=(i,), daemon=True)
@@ -100,8 +119,9 @@ class LocalPipeline:
 
         def feed():
             for x in inputs:
-                qs[0].put(x)
-            qs[0].put(_SENTINEL)
+                if not put_or_abort(qs[0], x):
+                    return
+            put_or_abort(qs[0], _SENTINEL)
 
         feeder = threading.Thread(target=feed, daemon=True)
         feeder.start()
@@ -115,12 +135,15 @@ class LocalPipeline:
                 break
             outputs.append(y)
         if error is not None:
-            raise RuntimeError(
-                f"stage {error.stage_index} failed during stream"
-            ) from error.exc
+            # Unblock producers so no threads leak, then surface the error.
+            abort.set()
         feeder.join()
         for t in threads:
             t.join()
+        if error is not None:
+            raise RuntimeError(
+                f"stage {error.stage_index} failed during stream"
+            ) from error.exc
         return outputs
 
     def throughput(self, inputs: Sequence[Any]) -> tuple[list, float]:
@@ -128,7 +151,8 @@ class LocalPipeline:
         benchmark measurement (``test/test.py:25-37``)."""
         start = time.perf_counter()
         outputs = self.stream(inputs)
-        jax.block_until_ready(outputs[-1])
+        if outputs:
+            jax.block_until_ready(outputs[-1])
         return outputs, time.perf_counter() - start
 
 
@@ -177,7 +201,8 @@ class ServingPipeline:
     def throughput(self, inputs: Sequence[Any]) -> tuple[list, float]:
         start = time.perf_counter()
         outputs = self.stream(inputs)
-        jax.block_until_ready(outputs[-1])
+        if outputs:
+            jax.block_until_ready(outputs[-1])
         return outputs, time.perf_counter() - start
 
     def kill_worker(self, index: int, mode: str = "crash") -> None:

@@ -264,3 +264,47 @@ def test_shutdown_fails_pending_futures(small_model, devices):
     with pytest.raises(RequestFailed, match="shut down|retries|no live"):
         f.result(10.0)
     assert time.monotonic() - t0 < 5.0  # prompt failure, not timeout sleep
+
+
+def test_stream_error_no_thread_leak(small_model, devices):
+    """After a failed stream, no stage/feeder threads may linger blocked
+    (regression: leaked producers on the error path)."""
+    import threading as _threading
+
+    g, variables, plan, x = small_model
+    pipe = LocalPipeline(plan, variables, devices[:3])
+    before = _threading.active_count()
+    bad_inputs = [x] * 2 + [jnp.ones((2, 5))] + [x] * 50
+    with pytest.raises(RuntimeError, match="failed during stream"):
+        pipe.stream(bad_inputs)
+    time.sleep(0.5)
+    assert _threading.active_count() <= before + 1
+
+
+def test_throughput_empty_inputs(small_model, devices):
+    _, variables, plan, _ = small_model
+    pipe = LocalPipeline(plan, variables, devices[:3])
+    outs, dt = pipe.throughput([])
+    assert outs == [] and dt >= 0
+
+
+def test_hung_worker_still_scheduled_and_recovered(small_model, devices):
+    """A hung worker stays schedulable (it heartbeats like a healthy one);
+    requests routed to it must be recovered by the deadline watchdog —
+    the true _task_watchdog path (regression: hang used to self-advertise
+    as DEAD and dodge scheduling)."""
+    g, variables, plan, x = small_model
+    global_metrics().reset()
+    cfg = ServeConfig(max_inflight=2, fault=FAST_FAULT)
+    pipe = ServingPipeline(plan, variables, devices[:2], cfg)
+    with pipe:
+        pipe.infer(x)  # configure both workers
+        pipe.kill_worker(0, mode="hang")
+        from adapt_tpu.control.worker import WorkerState
+
+        assert pipe.workers[0].state is not WorkerState.DEAD
+        outs = pipe.stream([x] * 4, timeout_per_request=30.0)
+        assert len(outs) == 4
+    m = global_metrics().snapshot()["counters"]
+    # The hung worker swallowed at least one task -> watchdog re-dispatched.
+    assert m.get("dispatcher.redispatched", 0) >= 1

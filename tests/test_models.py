@@ -87,3 +87,16 @@ def test_bfloat16_resnet(small_image):
     y = g.apply(variables, small_image)
     assert y.dtype == jnp.float32  # head casts logits back to f32
     assert np.isfinite(np.asarray(y)).all()
+
+
+def test_vit_block_cuts_validation():
+    from adapt_tpu.models.vit import vit_block_cuts
+
+    with pytest.raises(ValueError, match="cannot split"):
+        vit_block_cuts(4, 8)
+    assert vit_block_cuts(4, 4) == [
+        "encoder_block_0",
+        "encoder_block_1",
+        "encoder_block_2",
+    ]
+    assert vit_block_cuts(12, 3) == ["encoder_block_3", "encoder_block_7"]
