@@ -11,7 +11,9 @@ Must run before jax initializes a backend, hence env vars at import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the outer environment may pin JAX_PLATFORMS to a
+# real accelerator, but tests must always run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -20,6 +22,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# An interpreter-startup hook (sitecustomize) may import jax before this
+# conftest runs, freezing jax_platforms from the pre-existing env. Override
+# via the config API, which works after import as long as no backend has
+# been initialized yet.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
@@ -27,6 +35,11 @@ import pytest  # noqa: E402
 def devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    assert devs[0].platform == "cpu", (
+        "tests must run on the virtual CPU mesh, got platform "
+        f"{devs[0].platform!r} — a backend was initialized before conftest "
+        "could force jax_platforms=cpu"
+    )
     return devs
 
 
