@@ -4,10 +4,20 @@ Reference metric (BASELINE.json): "images/sec/chip (ResNet-50, bs=32)".
 The reference never published numbers (BASELINE.md); the baseline constant
 here is a single NVIDIA A100's framework-level ResNet-50 fp16 inference
 throughput at bs=32 (~3000 images/sec, XLA/TF-class stacks — TensorRT INT8
-figures are far higher but not framework-comparable). The north-star target
-is v5e-8 aggregate >= one A100; per-chip parity at 1/8th of the baseline is
-vs_baseline = 0.125 * 8 = 1.0 when extrapolated linearly across 8 chips —
-we report the honest per-chip ratio and let vs_baseline carry it.
+figures are far higher but not framework-comparable).
+
+Measurement methodology: the timed region is ONE jitted program that runs
+ITERS forward passes in a `lax.scan`, with each iteration's input carrying
+a data dependency on the previous iteration's logits. That shape is
+deliberate:
+- a Python-level dispatch loop under this image's remote-execution tunnel
+  over-reports wildly (repeat executions of identical (fn, args) are
+  deduplicated, and `block_until_ready` returns before execution
+  completes), so the loop must live on-device;
+- a loop-invariant body inside `scan` could be hoisted by XLA (LICM),
+  so each step's input must depend on the previous step's output.
+Wall clock is taken around a host fetch (`np.asarray`) of the scalar
+result, which is the only operation that provably waits for execution.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -16,36 +26,55 @@ Prints exactly ONE JSON line:
 from __future__ import annotations
 
 import json
+import statistics
 import sys
 import time
 
 A100_IMAGES_PER_SEC = 3000.0  # single-A100 fp16 bs32, framework-level
 BATCH = 32
-WARMUP = 10
-ITERS = 60
+ITERS = 100  # forwards per timed program; amortizes the tunnel round-trip
+TRIALS = 5
 
 
 def main() -> None:
     import jax
     import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
 
     from adapt_tpu.models.resnet import resnet50
 
     graph = resnet50(num_classes=1000, dtype=jnp.bfloat16)
-    x = jnp.ones((BATCH, 224, 224, 3), jnp.float32)
-    variables = jax.jit(graph.init)(jax.random.PRNGKey(0), x)
-    fwd = jax.jit(graph.apply)
+    x0 = jax.random.normal(
+        jax.random.PRNGKey(0), (BATCH, 224, 224, 3), jnp.float32
+    )
+    variables = jax.jit(graph.init)(jax.random.PRNGKey(0), x0)
 
-    for _ in range(WARMUP):
-        y = fwd(variables, x)
-    jax.block_until_ready(y)
+    def bench_fn(variables, x):
+        def body(x, _):
+            y = graph.apply(variables, x)
+            # Fold a negligible function of the logits back into the next
+            # input: keeps every iteration data-dependent (defeats LICM /
+            # cross-call dedup) without changing what is computed.
+            x = x * 0.999 + (jnp.mean(y) * 1e-6).astype(x.dtype)
+            return x, y[0, 0]
 
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        y = fwd(variables, x)
-    jax.block_until_ready(y)
-    dt = time.perf_counter() - t0
+        x, ys = lax.scan(body, x, None, length=ITERS)
+        return jnp.mean(ys)
 
+    fwd = jax.jit(bench_fn)
+    np.asarray(fwd(variables, x0))  # compile + warm
+
+    times = []
+    for i in range(TRIALS):
+        # Distinct input per trial: the tunnel dedups repeat executions of
+        # identical (fn, args), which would serve trials from cache.
+        x_trial = x0 + (i + 1) * 1e-6
+        t0 = time.perf_counter()
+        np.asarray(fwd(variables, x_trial))
+        times.append(time.perf_counter() - t0)
+
+    dt = statistics.median(times)
     images_per_sec = BATCH * ITERS / dt
     print(
         json.dumps(

@@ -10,15 +10,18 @@ Must run before jax initializes a backend, hence env vars at import time.
 """
 
 import os
+import re
 
 # Force, don't setdefault: the outer environment may pin JAX_PLATFORMS to a
 # real accelerator, but tests must always run on the virtual CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+_FLAG = "--xla_force_host_platform_device_count"
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_m = re.search(rf"{_FLAG}=(\d+)", _flags)
+if _m is None:
+    os.environ["XLA_FLAGS"] = f"{_flags} {_FLAG}=8".strip()
+elif int(_m.group(1)) < 8:
+    os.environ["XLA_FLAGS"] = re.sub(rf"{_FLAG}=\d+", f"{_FLAG}=8", _flags)
 
 import jax  # noqa: E402
 
