@@ -112,6 +112,17 @@ class Dispatcher:
             jax.jit(plan.stage_apply(spec)) for spec in plan.stages
         ]
         self._stage_host_vars = plan.extract_variables(variables)
+        # Precompiled re-shard plans (SURVEY.md §7.2.5): example input spec
+        # per stage (recorded on first dispatch) + the set of (stage,
+        # device) pairs whose executable is already in the jit cache.
+        # Prewarming every pair during warmup means a failover re-bind is a
+        # weight move, not an XLA recompile — the <2 s recovery budget.
+        self._stage_examples: dict[int, jax.ShapeDtypeStruct] = {}
+        self._prewarmed: set[tuple[int, Any]] = set()
+        self._prewarm_lock = threading.Lock()
+        self._prewarm_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="dispatcher-prewarm"
+        )
         self._workers: dict[str, StageWorker] = {}
         self._workers_lock = threading.Lock()
         self.result_queue: queue.Queue[TaskResult] = queue.Queue()
@@ -194,6 +205,7 @@ class Dispatcher:
         # Fail outstanding futures promptly instead of letting callers
         # sleep out their timeouts.
         self._forward_pool.shutdown(wait=False, cancel_futures=True)
+        self._prewarm_pool.shutdown(wait=False, cancel_futures=True)
         with self._inflight_lock:
             abandoned = list(self._inflight.values())
             self._inflight.clear()
@@ -228,12 +240,98 @@ class Dispatcher:
     def warmup(self, example, timeout: float | None = 300.0) -> None:
         """Run one request end-to-end with the watchdog paused, so
         first-compile time (tens of seconds on TPU) is paid here instead of
-        triggering spurious re-dispatches in serving."""
+        triggering spurious re-dispatches in serving. Then prewarm every
+        (stage, device) executable so failover never recompiles."""
         self._watchdog_paused = True
+        deadline = None if timeout is None else time.monotonic() + timeout
         try:
             self.infer(example, timeout)
+            self.prewarm_executables(wait=True, deadline=deadline)
         finally:
             self._watchdog_paused = False
+
+    # -- precompiled re-shard plans -----------------------------------------
+
+    def prewarm_executables(
+        self, wait: bool = False, deadline: float | None = None
+    ) -> None:
+        """Seed the shared jit cache with every (stage, live-worker-device)
+        executable, using each stage's recorded example input spec. The jit
+        cache keys on avals/shardings, not values, so compilation uses
+        device-created zero weights — no weight transfer, no lasting HBM
+        cost. With ``wait=True`` blocks until all pairs are compiled (or
+        ``deadline``, monotonic seconds, passes — best effort)."""
+        if self._shutdown.is_set():
+            return
+        with self._workers_lock:
+            devices = {
+                w.device
+                for w in self._workers.values()
+                if w.state is not WorkerState.DEAD
+            }
+        with self._prewarm_lock:
+            examples = dict(self._stage_examples)
+        futures = []
+        for stage_index, spec in examples.items():
+            for dev in devices:
+                with self._prewarm_lock:
+                    if (stage_index, dev) in self._prewarmed:
+                        continue
+                    self._prewarmed.add((stage_index, dev))
+                try:
+                    futures.append(
+                        self._prewarm_pool.submit(
+                            self._prewarm_one, stage_index, dev, spec
+                        )
+                    )
+                except RuntimeError:  # pool shut down concurrently
+                    with self._prewarm_lock:
+                        self._prewarmed.discard((stage_index, dev))
+                    return
+        if wait:
+            for f in futures:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    log.warning(
+                        "prewarm deadline passed with compiles outstanding; "
+                        "continuing in background"
+                    )
+                    break
+                try:
+                    f.result(timeout=remaining)
+                except TimeoutError:
+                    log.warning(
+                        "prewarm deadline passed with compiles outstanding; "
+                        "continuing in background"
+                    )
+                    break
+
+    def _prewarm_one(self, stage_index: int, device, spec) -> None:
+        try:
+            # Zero-valued weights created directly on the target device:
+            # compiles the identical executable (cache keys are avals +
+            # shardings) without moving the real weights. The device_put
+            # commits the already-on-device arrays — committed and
+            # uncommitted args key DIFFERENT cache entries, and serving
+            # calls use committed (device_put) arrays.
+            with jax.default_device(device):
+                variables = jax.tree.map(
+                    lambda a: jax.numpy.zeros(a.shape, a.dtype),
+                    self._stage_host_vars[stage_index],
+                )
+                x = jax.numpy.zeros(spec.shape, spec.dtype)
+            variables = jax.device_put(variables, device)
+            x = jax.device_put(x, device)
+            jax.block_until_ready(self._stage_fns[stage_index](variables, x))
+            global_metrics().inc("dispatcher.prewarmed")
+        except Exception as e:  # noqa: BLE001 — prewarm is best-effort
+            with self._prewarm_lock:
+                self._prewarmed.discard((stage_index, device))
+            log.warning(
+                "prewarm of stage %d on %s failed: %s", stage_index, device, e
+            )
 
     def serve_stream(self, inputs, timeout_per_request: float = 120.0):
         """Pump a stream through the pipeline, preserving order (reference
@@ -331,6 +429,15 @@ class Dispatcher:
         retries: int,
         exclude: set[str] | None = None,
     ) -> None:
+        if stage_index not in self._stage_examples:
+            try:
+                spec = jax.ShapeDtypeStruct(
+                    jax.numpy.shape(payload), payload.dtype
+                )
+                with self._prewarm_lock:
+                    self._stage_examples[stage_index] = spec
+            except Exception:  # noqa: BLE001 — non-array payloads: skip
+                pass
         worker = self._acquire(stage_index, exclude or set())
         entry = _Inflight(
             request_id=request_id,
@@ -476,7 +583,11 @@ class Dispatcher:
 
     def _on_membership(self, event: str, worker_id: str) -> None:
         """Reference ``_worker_monitor`` (:276): on worker death, don't wait
-        for task deadlines — immediately re-dispatch its in-flight tasks."""
+        for task deadlines — immediately re-dispatch its in-flight tasks.
+        On join, prewarm the newcomer's executables in the background."""
+        if event == "join":
+            self.prewarm_executables()
+            return
         if event != "leave":
             return
         with self._inflight_lock:

@@ -52,9 +52,16 @@ class LocalPipeline:
         variables,
         devices: Sequence[jax.Device] | None = None,
         donate_activations: bool = False,
+        hop_transform=None,
     ):
+        """``hop_transform(activation, stage_index) -> activation`` is
+        applied to every stage output before it is handed to the next stage
+        (and to the final result) — the reference compresses every hop this
+        way (zfp+lz4 on each activation, ``src/dispatcher.py:92-98``); pass
+        a codec round-trip here to model/pay that DCN-boundary cost."""
         devices = list(devices if devices is not None else jax.devices())
         self.plan = plan
+        self.hop_transform = hop_transform
         self.stages: list[CompiledStage] = compile_stages(
             plan, variables, devices, donate_activations=donate_activations
         )
@@ -63,6 +70,8 @@ class LocalPipeline:
         """Single-request path (latency)."""
         for stage in self.stages:
             x = stage(x)
+            if self.hop_transform is not None:
+                x = self.hop_transform(x, stage.spec.index)
         return x
 
     def warmup(self, example) -> None:
@@ -104,6 +113,8 @@ class LocalPipeline:
                     break
                 try:
                     y = stage(item)
+                    if self.hop_transform is not None:
+                        y = self.hop_transform(y, stage.spec.index)
                 except Exception as e:  # noqa: BLE001 — surface to caller
                     put_or_abort(qs[i + 1], _StageError(stage.spec.index, e))
                     break

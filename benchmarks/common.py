@@ -1,0 +1,68 @@
+"""Shared helpers for the benchmark drivers.
+
+Every driver prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+matching the repo-root ``bench.py`` contract, so results are machine
+comparable across configs (BASELINE.md "configs to reproduce").
+
+Measurement caveat baked in here (see bench.py's module docstring for the
+full story): under this image's remote-execution tunnel,
+``jax.block_until_ready`` can return before execution completes and repeat
+executions of identical (fn, args) are deduplicated. Honest wall-clock
+therefore requires (a) distinct inputs per request and (b) timing around a
+host fetch (``np.asarray``) of real outputs.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+
+def force_cpu_mesh(n_devices: int) -> None:
+    """Force an ``n_devices`` virtual CPU mesh (post-import safe). Same
+    mechanism as ``__graft_entry__._force_virtual_cpu``; duplicated because
+    benchmark drivers must stay runnable standalone from the repo root."""
+    import os
+
+    import jax
+
+    flag = "--xla_force_host_platform_device_count"
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"{flag}=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = f"{flags} {flag}={n_devices}".strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = re.sub(
+            rf"{flag}=\d+", f"{flag}={n_devices}", flags
+        )
+    jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
+    if len(devs) < n_devices:
+        raise RuntimeError(
+            f"could not get {n_devices} virtual CPU devices "
+            f"(have {len(devs)} {devs[0].platform})"
+        )
+
+
+def distinct_inputs(key, shape, n: int):
+    """``n`` device-resident inputs, each unique (defeats execution dedup)."""
+    import jax
+
+    return [
+        jax.device_put(jax.random.normal(jax.random.fold_in(key, i), shape))
+        for i in range(n)
+    ]
+
+
+def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(value, 4),
+                "unit": unit,
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )
