@@ -1,0 +1,18 @@
+from adapt_tpu.ops.quantize import (
+    QuantizedTensor,
+    dequantize,
+    dequantize_reference,
+    quantize,
+    quantize_reference,
+)
+from adapt_tpu.ops.attention import attention_reference, flash_attention
+
+__all__ = [
+    "QuantizedTensor",
+    "attention_reference",
+    "dequantize",
+    "dequantize_reference",
+    "flash_attention",
+    "quantize",
+    "quantize_reference",
+]

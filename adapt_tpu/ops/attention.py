@@ -1,0 +1,168 @@
+"""Fused blockwise (flash-style) attention as a Pallas TPU kernel.
+
+Not in the reference (SURVEY.md §2.2: CNN-only, no attention anywhere) but
+first-class here: this is the hot op of the ViT workload (BASELINE.md
+config 5) and the per-device block compute of ring attention
+(``adapt_tpu.parallel.ring_attention``). A fused kernel keeps the S x S
+score matrix out of HBM entirely — scores live in VMEM one (block_q,
+block_k) tile at a time with online-softmax accumulation, so memory is
+O(S * D) instead of O(S^2) and the matmuls stay on the MXU.
+
+Grid: (batch*heads, S/block_q). Each program holds one q block plus that
+(batch, head)'s full K/V in VMEM and loops over k blocks with running
+(max, denom, acc) — the standard online softmax recurrence.
+
+Off-TPU the kernel runs through the Pallas interpreter, so tests on the
+virtual CPU mesh exercise the same code path; ``attention_reference`` is
+the jnp oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, sm_scale):
+    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+    block_q, d = q.shape
+    seq_k = k_ref.shape[1]
+    num_kv = seq_k // block_k
+    q_start = pl.program_id(1) * block_q
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * sm_scale
+        )  # (block_q, block_k)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    # Causal: k blocks strictly after this q block contribute nothing.
+    if causal:
+        upper = jnp.minimum(
+            (q_start + block_q + block_k - 1) // block_k, num_kv
+        )
+    else:
+        upper = num_kv
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k")
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> jax.Array:
+    """Fused attention over (batch, heads, seq, head_dim) tensors.
+
+    Falls back to :func:`attention_reference` when the sequence is not
+    divisible by the block sizes (tiny/odd shapes).
+    """
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    if s_q % block_q or s_k % block_k:
+        return attention_reference(q, k, v, causal=causal)
+
+    sm_scale = 1.0 / math.sqrt(d)
+    qf = q.reshape(b * h, s_q, d)
+    kf = k.reshape(b * h, s_k, d)
+    vf = v.reshape(b * h, s_k, d)
+    kernel = functools.partial(
+        _attn_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s_q // block_q),
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, d), lambda bh, qi: (bh, qi, 0), memory_space=_VMEM
+            ),
+            pl.BlockSpec(
+                (1, s_k, d), lambda bh, qi: (bh, 0, 0), memory_space=_VMEM
+            ),
+            pl.BlockSpec(
+                (1, s_k, d), lambda bh, qi: (bh, 0, 0), memory_space=_VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda bh, qi: (bh, qi, 0), memory_space=_VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(qf, kf, vf)
+    return out.reshape(b, h, s_q, d)
+
+
+def attention_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+) -> jax.Array:
+    """Pure-jnp oracle: softmax(QK^T / sqrt(d)) V with optional causal mask.
+
+    Causal convention (same as the kernel): query at absolute position i
+    attends keys at absolute positions j <= i — top-left aligned, which is
+    the identity convention for the self-attention (s_q == s_k) shapes the
+    framework uses.
+    """
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / math.sqrt(d)
+    if causal:
+        s_q, s_k = s.shape[-2:]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(
+        q.dtype
+    )

@@ -1,0 +1,171 @@
+"""Blockwise int8 quantization as a Pallas TPU kernel.
+
+The TPU-native re-expression of the reference's per-hop lossy codec
+(zfp+lz4 on every activation and weight crossing a socket,
+``/root/reference/src/dispatcher.py:92-98``, ``src/node.py:122-125``).
+On TPU the codec's job moves on-device: quantize in VMEM right before a
+DCN-boundary transfer (4x smaller payload off-chip), dequantize on the
+other side — ICI hops need no codec at all (SURVEY.md §2.3).
+
+Layout: the flat tensor is viewed as (rows, 128) lanes and split into
+row-blocks; each block of ``block_rows * 128`` elements gets one f32
+scale (absmax / 127). Blockwise scales bound the quantization error per
+block — the same locality argument zfp's 4^d blocks make.
+
+Off-TPU (tests, CPU sim-mesh) the same kernels run through the Pallas
+interpreter, so behavior is identical everywhere; ``*_reference`` are the
+pure-jnp oracles used by the unit tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces are importable everywhere jax is, but be safe
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+    _SMEM = pltpu.SMEM
+except Exception:  # pragma: no cover - exotic builds
+    pltpu = None
+    _VMEM = None
+    _SMEM = None
+
+LANES = 128
+BLOCK_ROWS = 64  # one scale per 64*128 = 8192 elements
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantizedTensor:
+    """int8 payload + per-block scales + logical shape/dtype."""
+
+    values: jax.Array  # (rows, 128) int8, padded
+    scales: jax.Array  # (num_blocks, 1) f32
+    shape: tuple[int, ...]
+    dtype: jnp.dtype
+
+    def tree_flatten(self):
+        return (self.values, self.scales), (self.shape, self.dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        values, scales = children
+        shape, dtype = aux
+        return cls(values, scales, shape, dtype)
+
+    @property
+    def nbytes_payload(self) -> int:
+        return self.values.size + self.scales.size * 4
+
+
+def _quant_kernel(x_ref, vals_ref, scale_ref):
+    # scale_ref holds the FULL (num_blocks, 1) scales array in SMEM (TPU
+    # tiling forbids (1, 1) VMEM blocks); each grid step writes its slot.
+    amax = jnp.max(jnp.abs(x_ref[:]))
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    scale_ref[pl.program_id(0), 0] = scale
+    q = jnp.clip(jnp.round(x_ref[:] / scale), -127.0, 127.0)
+    vals_ref[:] = q.astype(jnp.int8)
+
+
+def _dequant_kernel(vals_ref, scale_ref, out_ref):
+    out_ref[:] = vals_ref[:].astype(jnp.float32) * scale_ref[pl.program_id(0), 0]
+
+
+def _to_rows(x: jax.Array) -> tuple[jax.Array, int]:
+    """Flatten to (rows, LANES) f32, zero-padded to whole blocks."""
+    flat = x.astype(jnp.float32).reshape(-1)
+    block = BLOCK_ROWS * LANES
+    pad = (-flat.size) % block
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(-1, LANES), flat.size // block
+
+
+@jax.jit
+def quantize(x: jax.Array) -> QuantizedTensor:
+    """Blockwise int8-quantize any-shape tensor (Pallas kernel)."""
+    rows, num_blocks = _to_rows(x)
+    vals, scales = pl.pallas_call(
+        _quant_kernel,
+        grid=(num_blocks,),
+        in_specs=[
+            pl.BlockSpec(
+                (BLOCK_ROWS, LANES), lambda i: (i, 0), memory_space=_VMEM
+            )
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (BLOCK_ROWS, LANES), lambda i: (i, 0), memory_space=_VMEM
+            ),
+            pl.BlockSpec(
+                (num_blocks, 1), lambda i: (0, 0), memory_space=_SMEM
+            ),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(rows.shape, jnp.int8),
+            jax.ShapeDtypeStruct((num_blocks, 1), jnp.float32),
+        ),
+        interpret=_interpret(),
+    )(rows)
+    return QuantizedTensor(vals, scales, tuple(x.shape), x.dtype)
+
+
+@jax.jit
+def dequantize(qt: QuantizedTensor) -> jax.Array:
+    """Inverse of :func:`quantize` (Pallas kernel)."""
+    num_blocks = qt.scales.shape[0]
+    out = pl.pallas_call(
+        _dequant_kernel,
+        grid=(num_blocks,),
+        in_specs=[
+            pl.BlockSpec(
+                (BLOCK_ROWS, LANES), lambda i: (i, 0), memory_space=_VMEM
+            ),
+            pl.BlockSpec(
+                (num_blocks, 1), lambda i: (0, 0), memory_space=_SMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (BLOCK_ROWS, LANES), lambda i: (i, 0), memory_space=_VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct(qt.values.shape, jnp.float32),
+        interpret=_interpret(),
+    )(qt.values, qt.scales)
+    size = 1
+    for d in qt.shape:
+        size *= d
+    return out.reshape(-1)[:size].reshape(qt.shape).astype(qt.dtype)
+
+
+# -- pure-jnp oracles (unit-test ground truth) -------------------------------
+
+
+def quantize_reference(x: jax.Array) -> QuantizedTensor:
+    rows, num_blocks = _to_rows(x)
+    blocks = rows.reshape(num_blocks, -1)
+    amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scales = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(blocks / scales), -127.0, 127.0).astype(jnp.int8)
+    return QuantizedTensor(
+        q.reshape(rows.shape), scales, tuple(x.shape), x.dtype
+    )
+
+
+def dequantize_reference(qt: QuantizedTensor) -> jax.Array:
+    num_blocks = qt.scales.shape[0]
+    blocks = qt.values.reshape(num_blocks, -1).astype(jnp.float32)
+    out = (blocks * qt.scales).reshape(-1)
+    size = 1
+    for d in qt.shape:
+        size *= d
+    return out[:size].reshape(qt.shape).astype(qt.dtype)

@@ -114,12 +114,9 @@ def ring_attention(
 
 
 def full_attention(q, k, v, causal: bool = False) -> jax.Array:
-    """Single-device reference implementation (the correctness oracle)."""
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-    if causal:
-        seq_q, seq_k = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((seq_q, seq_k), bool))
-        s = jnp.where(mask, s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    """Single-device oracle — delegates to the one canonical reference in
+    :mod:`adapt_tpu.ops.attention` (same causal convention: absolute
+    position i attends j <= i)."""
+    from adapt_tpu.ops.attention import attention_reference
+
+    return attention_reference(q, k, v, causal=causal)

@@ -16,33 +16,15 @@ host fetch (``np.asarray``) of real outputs.
 from __future__ import annotations
 
 import json
-import re
 
 
 def force_cpu_mesh(n_devices: int) -> None:
-    """Force an ``n_devices`` virtual CPU mesh (post-import safe). Same
-    mechanism as ``__graft_entry__._force_virtual_cpu``; duplicated because
-    benchmark drivers must stay runnable standalone from the repo root."""
-    import os
+    """Force an ``n_devices`` virtual CPU mesh (post-import safe). Thin
+    wrapper over ``__graft_entry__._force_virtual_cpu`` — the drivers put
+    the repo root on sys.path, so the one implementation is shared."""
+    from __graft_entry__ import _force_virtual_cpu
 
-    import jax
-
-    flag = "--xla_force_host_platform_device_count"
-    flags = os.environ.get("XLA_FLAGS", "")
-    m = re.search(rf"{flag}=(\d+)", flags)
-    if m is None:
-        os.environ["XLA_FLAGS"] = f"{flags} {flag}={n_devices}".strip()
-    elif int(m.group(1)) < n_devices:
-        os.environ["XLA_FLAGS"] = re.sub(
-            rf"{flag}=\d+", f"{flag}={n_devices}", flags
-        )
-    jax.config.update("jax_platforms", "cpu")
-    devs = jax.devices()
-    if len(devs) < n_devices:
-        raise RuntimeError(
-            f"could not get {n_devices} virtual CPU devices "
-            f"(have {len(devs)} {devs[0].platform})"
-        )
+    _force_virtual_cpu(n_devices)
 
 
 def distinct_inputs(key, shape, n: int):

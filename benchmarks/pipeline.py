@@ -39,7 +39,7 @@ BATCH = 1
 def build(config: str):
     import jax.numpy as jnp
 
-    from adapt_tpu.graph.partition import balanced_cuts, partition
+    from adapt_tpu.graph.partition import balanced_cuts
 
     if config == "resnet50-3stage":
         from adapt_tpu.models.resnet import resnet50
@@ -70,8 +70,7 @@ def _int8_hop():
     92-98``), expressed as the TPU-native DCN-boundary codec."""
     import numpy as np
 
-    from adapt_tpu.comm.codec import pack, unpack
-    from adapt_tpu.comm.codec import get_codec
+    from adapt_tpu.comm.codec import get_codec, pack, unpack
 
     codec = get_codec("int8")
 

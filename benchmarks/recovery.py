@@ -89,6 +89,7 @@ def main() -> None:
                     if w.state is WorkerState.BUSY or w.queue_depth > 0:
                         victim = w
                         break
+                time.sleep(0.001)  # don't contend with the mesh under test
             if victim is None:  # burst already drained; any configured worker
                 victim = next(
                     w

@@ -1,0 +1,140 @@
+"""Pallas-kernel tests (interpreter mode on the virtual CPU mesh).
+
+The kernels are the TPU hot-ops layer: blockwise int8 quantization (the
+reference's per-hop lossy codec re-expressed on-device, SURVEY.md §2.3)
+and fused flash attention (the ViT / ring-attention block compute).
+Oracles are the pure-jnp ``*_reference`` implementations.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adapt_tpu.ops import (
+    attention_reference,
+    dequantize,
+    dequantize_reference,
+    flash_attention,
+    quantize,
+    quantize_reference,
+)
+
+
+# -- quantize ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "shape", [(8, 8), (2, 224, 3), (4, 64, 128), (1, 8191)]
+)
+def test_quantize_matches_reference(rng, shape):
+    x = jax.random.normal(rng, shape) * 5.0
+    qt = quantize(x)
+    ref = quantize_reference(x)
+    np.testing.assert_array_equal(np.asarray(qt.values), np.asarray(ref.values))
+    np.testing.assert_allclose(
+        np.asarray(qt.scales), np.asarray(ref.scales), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(dequantize(qt)),
+        np.asarray(dequantize_reference(ref)),
+        rtol=1e-6,
+        atol=1e-6,
+    )
+
+
+def test_quantize_roundtrip_error_bound(rng):
+    x = jax.random.normal(rng, (32, 512)) * 3.0
+    y = dequantize(quantize(x))
+    assert y.shape == x.shape and y.dtype == x.dtype
+    # Per-block absmax scaling bounds error by scale/2 = absmax/254.
+    err = np.abs(np.asarray(y) - np.asarray(x)).max()
+    assert err <= float(jnp.abs(x).max()) / 254.0 + 1e-6
+
+
+def test_quantize_preserves_dtype_bf16(rng):
+    x = jax.random.normal(rng, (16, 256)).astype(jnp.bfloat16)
+    y = dequantize(quantize(x))
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32),
+        np.asarray(x, np.float32),
+        atol=float(jnp.abs(x.astype(jnp.float32)).max()) / 100.0,
+    )
+
+
+def test_quantized_tensor_is_pytree(rng):
+    x = jax.random.normal(rng, (8, 128))
+    qt = quantize(x)
+    moved = jax.tree.map(lambda a: a, qt)
+    np.testing.assert_array_equal(
+        np.asarray(moved.values), np.asarray(qt.values)
+    )
+    assert moved.shape == qt.shape
+
+
+def test_quantize_constant_and_zero_blocks():
+    x = jnp.zeros((64, 128))
+    y = dequantize(quantize(x))
+    np.testing.assert_array_equal(np.asarray(y), 0.0)
+    x2 = jnp.full((64, 128), 7.5)
+    y2 = dequantize(quantize(x2))
+    np.testing.assert_allclose(np.asarray(y2), 7.5, rtol=1e-2)
+
+
+# -- flash attention --------------------------------------------------------
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_reference(rng, causal):
+    b, h, s, d = 2, 2, 256, 64
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (b, h, s, d))
+    k = jax.random.normal(kk, (b, h, s, d))
+    v = jax.random.normal(kv, (b, h, s, d))
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_attention_small_blocks(rng):
+    b, h, s, d = 1, 2, 128, 32
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (b, h, s, d))
+    k = jax.random.normal(kk, (b, h, s, d))
+    v = jax.random.normal(kv, (b, h, s, d))
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=64)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_attention_indivisible_falls_back(rng):
+    # s=200 > default block 128 and 200 % 128 != 0 -> reference fallback.
+    b, h, s, d = 1, 1, 200, 16
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (b, h, s, d))
+    k = jax.random.normal(kk, (b, h, s, d))
+    v = jax.random.normal(kv, (b, h, s, d))
+    out = flash_attention(q, k, v, causal=False)
+    ref = attention_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_attention_bf16(rng):
+    b, h, s, d = 1, 2, 128, 64
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (b, h, s, d)).astype(jnp.bfloat16)
+    k = jax.random.normal(kk, (b, h, s, d)).astype(jnp.bfloat16)
+    v = jax.random.normal(kv, (b, h, s, d)).astype(jnp.bfloat16)
+    out = flash_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
+    )
