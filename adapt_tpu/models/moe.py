@@ -1,0 +1,138 @@
+"""Mixture-of-Experts MLP with Switch/GShard-style static routing.
+
+Beyond reference parity (SURVEY.md §2.2: no MoE constructs anywhere) but
+first-class here as the expert-parallel workload. The design is
+TPU-idiomatic end to end: routing is expressed as dense one-hot einsums
+with a STATIC per-expert capacity, so the whole layer is fixed-shape — no
+gather/scatter, no data-dependent shapes, everything tiles onto the MXU
+and shards cleanly.
+
+Routing (top-k, k in {1, 2}): softmax gate over experts; each token's
+chosen expert slot is its prefix-count position in that expert's queue
+(cumsum over the one-hot); tokens past ``capacity = ceil(cf * N * k / E)``
+are dropped (their combine weight is zero, output falls back to the
+residual path of the surrounding block). Aux load-balance loss is the
+standard mean(fraction_tokens * fraction_probs) * E.
+
+Expert parallelism: expert-stacked params carry a leading ``E`` dim;
+:func:`adapt_tpu.parallel.expert.expert_shardings` shards that dim over
+the ``ep`` mesh axis and GSPMD turns the dispatch/combine einsums into
+all-to-alls over ICI (the scaling-book recipe — annotate, don't hand-roll
+collectives).
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _one_hot_routing(gates: jax.Array, capacity: int, top_k: int):
+    """Build (dispatch [N,E,C], combine [N,E,C], aux_loss) from gate
+    probabilities [N, E]."""
+    n, e = gates.shape
+    dispatch_slots = []
+    combine_weights = []
+    remaining = gates
+    # Track how full each expert queue already is from earlier choices.
+    base_count = jnp.zeros((e,), jnp.int32)
+    importance = jnp.zeros((e,), gates.dtype)
+    for choice in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)  # [N]
+        onehot = jax.nn.one_hot(idx, e, dtype=gates.dtype)  # [N, E]
+        prob = jnp.sum(gates * onehot, axis=-1)  # [N]
+        pos = (
+            jnp.cumsum(onehot, axis=0) - 1.0 + base_count[None, :]
+        ) * onehot  # [N, E]
+        slot = jnp.sum(pos, axis=-1).astype(jnp.int32)  # [N]
+        keep = slot < capacity
+        dispatch = (
+            onehot[:, :, None]
+            * jax.nn.one_hot(slot, capacity, dtype=gates.dtype)[:, None, :]
+            * keep[:, None, None]
+        )  # [N, E, C]
+        dispatch_slots.append(dispatch)
+        combine_weights.append(dispatch * prob[:, None, None])
+        base_count = base_count + jnp.sum(
+            onehot * keep[:, None], axis=0
+        ).astype(jnp.int32)
+        if choice == 0:  # Switch-style: balance the first-choice fraction
+            importance = importance + jnp.sum(onehot, axis=0)
+        remaining = remaining * (1.0 - onehot)  # mask chosen expert
+    dispatch = sum(dispatch_slots)
+    combine = sum(combine_weights)
+    # Load-balance aux loss over the FIRST choice distribution.
+    frac_tokens = importance / jnp.maximum(jnp.sum(importance), 1.0)
+    frac_probs = jnp.mean(gates, axis=0)
+    aux = jnp.sum(frac_tokens * frac_probs) * e
+    return dispatch, combine, aux
+
+
+class MoEMlp(nn.Module):
+    """Token-routed expert MLP: [B, S, D] -> [B, S, D]."""
+
+    num_experts: int = 8
+    hidden_dim: int = 128
+    top_k: int = 1
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        assert self.top_k in (1, 2), "top_k must be 1 or 2"
+        assert self.top_k <= self.num_experts, (
+            f"top_k={self.top_k} needs >= that many experts "
+            f"(got {self.num_experts}); a second choice would re-route to "
+            "the same expert and double the output"
+        )
+        b, s, d = x.shape
+        n = b * s
+        e = self.num_experts
+        capacity = max(
+            1, math.ceil(self.capacity_factor * n * self.top_k / e)
+        )
+        tokens = x.reshape(n, d)
+
+        wg = self.param(
+            "gate", nn.initializers.lecun_normal(), (d, e), jnp.float32
+        )
+        w1 = self.param(
+            "w1",
+            nn.initializers.lecun_normal(),
+            (e, d, self.hidden_dim),
+            jnp.float32,
+        )
+        b1 = self.param("b1", nn.initializers.zeros, (e, self.hidden_dim))
+        w2 = self.param(
+            "w2",
+            nn.initializers.lecun_normal(),
+            (e, self.hidden_dim, d),
+            jnp.float32,
+        )
+        b2 = self.param("b2", nn.initializers.zeros, (e, d))
+
+        gates = jax.nn.softmax(
+            (tokens.astype(jnp.float32)) @ wg, axis=-1
+        ).astype(self.dtype)
+        dispatch, combine, aux = _one_hot_routing(
+            gates, capacity, self.top_k
+        )
+        self.sow("intermediates", "aux_loss", aux)
+
+        xt = tokens.astype(self.dtype)
+        # Dispatch: [N,E,C] x [N,D] -> [E,C,D]; with w1/w2 sharded on E,
+        # GSPMD lowers this to an all-to-all over the ep axis.
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, xt)
+        h = jax.nn.gelu(
+            jnp.einsum("ecd,edh->ech", expert_in, w1.astype(self.dtype))
+            + b1[:, None, :].astype(self.dtype)
+        )
+        expert_out = (
+            jnp.einsum("ech,ehd->ecd", h, w2.astype(self.dtype))
+            + b2[:, None, :].astype(self.dtype)
+        )
+        out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+        return out.reshape(b, s, d).astype(x.dtype)

@@ -20,6 +20,7 @@ pure-jnp oracles used by the unit tests.
 from __future__ import annotations
 
 import dataclasses
+import math
 
 import jax
 import jax.numpy as jnp
@@ -141,9 +142,7 @@ def dequantize(qt: QuantizedTensor) -> jax.Array:
         out_shape=jax.ShapeDtypeStruct(qt.values.shape, jnp.float32),
         interpret=_interpret(),
     )(qt.values, qt.scales)
-    size = 1
-    for d in qt.shape:
-        size *= d
+    size = math.prod(qt.shape)
     return out.reshape(-1)[:size].reshape(qt.shape).astype(qt.dtype)
 
 
@@ -165,7 +164,5 @@ def dequantize_reference(qt: QuantizedTensor) -> jax.Array:
     num_blocks = qt.scales.shape[0]
     blocks = qt.values.reshape(num_blocks, -1).astype(jnp.float32)
     out = (blocks * qt.scales).reshape(-1)
-    size = 1
-    for d in qt.shape:
-        size *= d
+    size = math.prod(qt.shape)
     return out[:size].reshape(qt.shape).astype(qt.dtype)

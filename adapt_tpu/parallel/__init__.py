@@ -1,5 +1,6 @@
 from adapt_tpu.parallel.pipeline_spmd import spmd_pipeline, stack_stage_params
 from adapt_tpu.parallel.ring_attention import ring_attention
+from adapt_tpu.parallel.ulysses import ulysses_attention
 from adapt_tpu.parallel.sharding import (
     batch_sharding,
     replicate,
@@ -11,6 +12,7 @@ __all__ = [
     "spmd_pipeline",
     "stack_stage_params",
     "ring_attention",
+    "ulysses_attention",
     "batch_sharding",
     "replicate",
     "shard_batch",

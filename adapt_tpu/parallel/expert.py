@@ -1,0 +1,73 @@
+"""Expert parallelism: shard the expert dimension over an ``ep`` mesh axis.
+
+Beyond reference parity (SURVEY.md §2.2: no EP). The GSPMD route: MoE
+params are expert-stacked (leading ``E`` dim — see
+:class:`adapt_tpu.models.moe.MoEMlp`); shard that dim over ``ep``,
+replicate everything else, and XLA lowers the dispatch/combine einsums
+([N,E,C] x [N,D] -> [E,C,D] and back) into all-to-alls over ICI. No
+hand-rolled collectives — annotate and let the compiler schedule
+(the scaling-book recipe).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _is_expert_stacked(
+    path: tuple, leaf, num_experts: int, exclude: tuple[str, ...]
+) -> bool:
+    keystr = jax.tree_util.keystr(path)
+    if any(name in keystr for name in exclude):
+        return False
+    return (
+        hasattr(leaf, "ndim")
+        and leaf.ndim >= 1
+        and leaf.shape[0] == num_experts
+    )
+
+
+def expert_shardings(
+    params,
+    mesh: Mesh,
+    num_experts: int,
+    axis: str = "ep",
+    exclude: tuple[str, ...] = ("gate",),
+):
+    """NamedShardings for a MoE param tree: leaves whose leading dim is the
+    expert count get P(axis, ...); everything else is replicated.
+    ``exclude`` lists path substrings that are never expert-stacked — the
+    router's ``gate`` [D, E] by default, which would otherwise be
+    mis-sharded whenever D happens to equal the expert count."""
+
+    def shard_one(path, leaf):
+        if _is_expert_stacked(path, leaf, num_experts, exclude):
+            return NamedSharding(
+                mesh, P(axis, *([None] * (leaf.ndim - 1)))
+            )
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(shard_one, params)
+
+
+def place_experts(
+    params,
+    mesh: Mesh,
+    num_experts: int,
+    axis: str = "ep",
+    exclude: tuple[str, ...] = ("gate",),
+):
+    """device_put the param tree per :func:`expert_shardings`."""
+    return jax.device_put(
+        params, expert_shardings(params, mesh, num_experts, axis, exclude)
+    )
+
+
+def expert_utilization(gates: jax.Array) -> np.ndarray:
+    """Fraction of top-1 routed tokens per expert — the EP load-balance
+    observability hook (pairs with MoEMlp's sown aux_loss)."""
+    idx = np.asarray(gates.argmax(axis=-1)).reshape(-1)
+    counts = np.bincount(idx, minlength=gates.shape[-1])
+    return counts / max(1, idx.size)

@@ -1,0 +1,133 @@
+"""Checkpoint / resume (orbax-backed).
+
+The reference has NO checkpointing (SURVEY.md §5): its nearest analog is
+the dispatcher holding the full model in RAM and re-sending slices on
+demand (``/root/reference/src/dispatcher.py:223-264``) plus retained
+in-flight payloads (``:190-194``). Framework-owned upgrade, two layers:
+
+- ``save_variables`` / ``restore_variables``: one pytree snapshot on disk
+  (orbax StandardCheckpointer) with a JSON sidecar for framework metadata
+  (model name, partition cuts, step) — enough to re-materialize a serving
+  pipeline: restore host-side, hand to ``ServingPipeline``/``Dispatcher``
+  which device_put stage slices as workers are configured.
+- ``TrainCheckpointer``: step-numbered train state (params + opt_state)
+  with retention and latest-step resume, for the training path
+  (``adapt_tpu.parallel.train`` — beyond reference parity).
+
+Restores are host-first by design: placement is the dispatcher's job
+(late binding, SURVEY.md §2.7), so checkpoints stay mesh-shape-agnostic —
+a checkpoint taken on an 8-chip mesh restores onto any survivor count.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+_META_NAME = "adapt_meta.json"
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def _to_host(tree: Any) -> Any:
+    return jax.tree.map(np.asarray, tree)
+
+
+def save_variables(
+    path: str | os.PathLike,
+    variables: Any,
+    metadata: dict | None = None,
+) -> None:
+    """Write one pytree checkpoint (+ JSON metadata sidecar) at ``path``."""
+    path = os.path.abspath(os.fspath(path))
+    ocp = _ocp()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, _to_host(variables), force=True)
+    if metadata is not None:
+        with open(os.path.join(path, _META_NAME), "w") as f:
+            json.dump(metadata, f)
+
+
+def restore_variables(
+    path: str | os.PathLike, example: Any | None = None
+) -> tuple[Any, dict]:
+    """Restore (variables, metadata). ``example`` (a matching pytree of
+    arrays or ShapeDtypeStructs) pins structure/dtypes; without it orbax
+    restores the saved layout as plain numpy arrays."""
+    path = os.path.abspath(os.fspath(path))
+    ocp = _ocp()
+    with ocp.StandardCheckpointer() as ckptr:
+        if example is not None:
+            target = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), example
+            )
+            variables = ckptr.restore(path, target)
+        else:
+            variables = ckptr.restore(path)
+    meta_path = os.path.join(path, _META_NAME)
+    metadata: dict = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            metadata = json.load(f)
+    return variables, metadata
+
+
+class TrainCheckpointer:
+    """Step-numbered train-state checkpoints with retention + resume."""
+
+    def __init__(self, directory: str | os.PathLike, max_to_keep: int = 3):
+        ocp = _ocp()
+        self._dir = os.path.abspath(os.fspath(directory))
+        self._mngr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, params: Any, opt_state: Any) -> None:
+        ocp = _ocp()
+        self._mngr.save(
+            step,
+            args=ocp.args.StandardSave(
+                {"params": _to_host(params), "opt_state": _to_host(opt_state)}
+            ),
+        )
+        self._mngr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore(
+        self, params_example: Any, opt_state_example: Any, step: int | None = None
+    ) -> tuple[Any, Any, int]:
+        """Restore (params, opt_state, step); latest step if not given."""
+        ocp = _ocp()
+        step = self._mngr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self._dir}")
+        target = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype),
+            {"params": params_example, "opt_state": opt_state_example},
+        )
+        restored = self._mngr.restore(
+            step, args=ocp.args.StandardRestore(target)
+        )
+        return restored["params"], restored["opt_state"], step
+
+    def close(self) -> None:
+        self._mngr.close()
+
+    def __enter__(self) -> "TrainCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -159,3 +159,43 @@ def test_tp_dp_sharded_vit_matches_replicated(devices):
     x_sharded = shard_batch(x, mesh, "dp")
     y = jax.jit(g.apply)(sharded_vars, x_sharded)
     np.testing.assert_allclose(np.asarray(y), y_ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(sp_mesh, causal):
+    from adapt_tpu.parallel.ulysses import ulysses_attention
+
+    b, h, s, d = 2, 8, 64, 16  # h == sp size, s divisible by 8
+    key = jax.random.PRNGKey(11)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, s, d))
+    k = jax.random.normal(kk, (b, h, s, d))
+    v = jax.random.normal(kv, (b, h, s, d))
+    out = ulysses_attention(q, k, v, sp_mesh, axis="sp", causal=causal)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ulysses_rejects_indivisible_heads(sp_mesh):
+    from adapt_tpu.parallel.ulysses import ulysses_attention
+
+    q = jnp.ones((1, 6, 64, 8))  # 6 heads not divisible by 8 ranks
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(q, q, q, sp_mesh, axis="sp")
+
+
+def test_ulysses_with_flash_block(sp_mesh):
+    from adapt_tpu.ops import flash_attention
+    from adapt_tpu.parallel.ulysses import ulysses_attention
+
+    b, h, s, d = 1, 8, 128, 16
+    q = jax.random.normal(jax.random.PRNGKey(12), (b, h, s, d))
+    out = ulysses_attention(
+        q, q, q, sp_mesh, axis="sp", causal=True, attn_fn=flash_attention
+    )
+    ref = full_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
