@@ -32,6 +32,9 @@ class FaultConfig:
     startup_wait_s: float = 5.0
     # Max re-dispatch attempts per task before failing the request.
     max_retries: int = 3
+    # Deadline misses before a still-heartbeating worker (a hang) is
+    # quarantined — scheduler stops acquiring it except as last resort.
+    quarantine_strikes: int = 2
     # Worker-configuration handshake timeout; reference: connect 5 s /
     # ACK 60 s (dispatcher.py:226,250-260).
     configure_timeout_s: float = 60.0

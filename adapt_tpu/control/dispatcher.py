@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import itertools
 import queue
+import random
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -49,6 +50,7 @@ class PipelineFuture:
 
     def __init__(self, request_id: int):
         self.request_id = request_id
+        self.submit_time = time.monotonic()
         self._event = threading.Event()
         self._value: Any = None
         self._error: str | None = None
@@ -88,6 +90,10 @@ class _Inflight:
     start_time: float
     retries: int = 0
     future: PipelineFuture = field(default=None)  # type: ignore[assignment]
+    # Workers that already failed/stalled this request: re-dispatch excludes
+    # ALL of them, not just the latest (a pool with several hung workers
+    # must not bounce one request among them until retries burn out).
+    tried: set[str] = field(default_factory=set)
 
 
 class Dispatcher:
@@ -131,6 +137,19 @@ class Dispatcher:
         self._sem = threading.Semaphore(self.config.max_inflight)
         self._req_ids = itertools.count()
         self._watchdog_paused = False
+        # Strike-based quarantine: a worker that keeps missing task
+        # deadlines while heartbeating (a hang) is never evicted by lease
+        # expiry; after `quarantine_strikes` deadline misses the scheduler
+        # stops acquiring it (the reference's socket-error eviction,
+        # src/dispatcher.py:153-161, generalized to hangs).
+        self._strikes: dict[str, int] = {}
+        self._quarantined: set[str] = set()
+        # Liveness evidence: worker_id -> monotonic time of its last
+        # successful result. Rank trusts recently-proven workers over
+        # attractive-looking silent ones (a hung worker looks idle and
+        # configured forever).
+        self._last_ok: dict[str, float] = {}
+        self._rng = random.Random(0x5EED)
         # Forward/re-dispatch pool: _acquire can block on a weight transfer
         # (configure), which must never stall the result loop or the
         # registry reaper (the reference likewise forwards in spawned
@@ -359,15 +378,40 @@ class Dispatcher:
             ]
         if not pool:
             raise RequestFailed("no live workers")
-        candidates = [w for w in pool if w.worker_id not in exclude] or pool
+        # Preference cascade: healthy & untried > quarantined & untried
+        # (quarantine is a soft signal; a worker this request hasn't tried
+        # yet still beats re-picking one that just failed it) > anyone.
+        healthy = [w for w in pool if w.worker_id not in self._quarantined]
+        candidates = (
+            [w for w in healthy if w.worker_id not in exclude]
+            or [w for w in pool if w.worker_id not in exclude]
+            or healthy
+            or pool
+        )
+
+        now = time.monotonic()
+        recent_window = self.config.fault.task_deadline_s
 
         def rank(w: StageWorker):
             return (
+                # Any missed deadline (even below the quarantine threshold)
+                # demotes a worker: a hung worker looks perfectly idle and
+                # configured — the most attractive rank — so strike feedback
+                # must outweigh attractiveness.
+                min(self._strikes.get(w.worker_id, 0), 1),
+                # Proven liveness beats attractiveness: a worker that
+                # completed something within one deadline window outranks
+                # one that has been silent (hung workers are silent).
+                0 if now - self._last_ok.get(w.worker_id, -1e9) < recent_window else 1,
                 0 if w.is_configured(stage_index) else 1,
                 0 if w.state is WorkerState.IDLE else 1,
                 w.queue_depth,
             )
 
+        # Random tie-break: concurrent re-dispatch waves must scatter over
+        # equal-rank candidates, not herd onto one deterministic victim
+        # (which would burn one deadline per worker, serially).
+        self._rng.shuffle(candidates)
         last_error: Exception | None = None
         for worker in sorted(candidates, key=rank):
             if worker.is_configured(stage_index):
@@ -438,7 +482,8 @@ class Dispatcher:
                     self._stage_examples[stage_index] = spec
             except Exception:  # noqa: BLE001 — non-array payloads: skip
                 pass
-        worker = self._acquire(stage_index, exclude or set())
+        exclude = exclude or set()
+        worker = self._acquire(stage_index, exclude)
         entry = _Inflight(
             request_id=request_id,
             stage_index=stage_index,
@@ -448,6 +493,7 @@ class Dispatcher:
             start_time=time.monotonic(),
             retries=retries,
             future=future,
+            tried=exclude | {worker.worker_id},
         )
         with self._inflight_lock:
             self._inflight[request_id] = entry
@@ -507,7 +553,7 @@ class Dispatcher:
                 entry.future,
                 attempt=entry.attempt + 1,
                 retries=entry.retries + 1,
-                exclude={entry.worker_id},
+                exclude=entry.tried,  # includes entry.worker_id by construction
             )
         except Exception as e:
             with self._inflight_lock:
@@ -520,6 +566,11 @@ class Dispatcher:
             global_metrics().inc(
                 "dispatcher.completed" if error is None else "dispatcher.failed"
             )
+            if error is None:
+                global_metrics().observe(
+                    "request.latency_s",
+                    time.monotonic() - future.submit_time,
+                )
 
     # -- loops --------------------------------------------------------------
 
@@ -548,6 +599,13 @@ class Dispatcher:
                     self._redispatch, entry, f"error: {result.error}"
                 )
                 continue
+            # A successful result clears the worker's strike record — a
+            # transient stall (queue backlog, first compile) must not
+            # sideline a healthy worker forever — and refreshes its
+            # liveness evidence for rank.
+            self._last_ok[result.worker_id] = time.monotonic()
+            if self._strikes.pop(result.worker_id, None) is not None:
+                self._quarantined.discard(result.worker_id)
             next_stage = result.stage_index + 1
             if next_stage < self.plan.num_stages:
                 self._forward_pool.submit(
@@ -577,6 +635,34 @@ class Dispatcher:
                         overdue.append(entry)
                         del self._inflight[rid]
             for entry in overdue:
+                strikes = self._strikes.get(entry.worker_id, 0) + 1
+                self._strikes[entry.worker_id] = strikes
+                if (
+                    strikes >= self.config.fault.quarantine_strikes
+                    and entry.worker_id not in self._quarantined
+                ):
+                    self._quarantined.add(entry.worker_id)
+                    global_metrics().inc("dispatcher.quarantined")
+                    log.warning(
+                        "worker %s quarantined after %d missed deadlines",
+                        entry.worker_id,
+                        strikes,
+                    )
+                    # Everything else in flight on a just-quarantined
+                    # worker is almost certainly doomed too — drain the
+                    # pile-up now instead of one deadline at a time.
+                    with self._inflight_lock:
+                        doomed = [
+                            e
+                            for e in self._inflight.values()
+                            if e.worker_id == entry.worker_id
+                        ]
+                        for e in doomed:
+                            del self._inflight[e.request_id]
+                    for e in doomed:
+                        self._forward_pool.submit(
+                            self._redispatch, e, "co-resident with quarantine"
+                        )
                 self._forward_pool.submit(
                     self._redispatch, entry, "deadline exceeded"
                 )
@@ -590,6 +676,10 @@ class Dispatcher:
             return
         if event != "leave":
             return
+        # A departed worker's record dies with it; a future re-join under
+        # the same id starts with a clean slate.
+        self._strikes.pop(worker_id, None)
+        self._quarantined.discard(worker_id)
         with self._inflight_lock:
             orphaned = [
                 e for e in self._inflight.values() if e.worker_id == worker_id

@@ -31,6 +31,7 @@ from adapt_tpu.config import FaultConfig
 from adapt_tpu.control.registry import WorkerRegistry
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
+from adapt_tpu.utils.tracing import global_tracer
 
 log = get_logger("worker")
 
@@ -211,9 +212,15 @@ class StageWorker:
                         f"stage {task.stage_index} not configured on "
                         f"{self.worker_id}"
                     )
-                x = jax.device_put(task.payload, self.device)
-                y = binding.fn(binding.variables, x)
-                y.block_until_ready()
+                with global_tracer().span(
+                    "stage_exec",
+                    stage=task.stage_index,
+                    worker=self.worker_id,
+                    request=task.request_id,
+                ):
+                    x = jax.device_put(task.payload, self.device)
+                    y = binding.fn(binding.variables, x)
+                    y.block_until_ready()
                 self._results.put(
                     TaskResult(
                         request_id=task.request_id,

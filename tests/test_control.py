@@ -356,3 +356,39 @@ def test_local_pipeline_hop_transform(small_model, devices):
     calls.clear()
     outs = pipe.stream([x, x])
     assert len(outs) == 2 and sorted(calls) == [0, 0, 1, 1, 2, 2]
+
+
+def test_hung_worker_quarantined_after_strikes(small_model, devices):
+    """A hang (heartbeats alive, swallows tasks) must be quarantined after
+    `quarantine_strikes` missed deadlines — later requests never touch it."""
+    g, variables, plan, x = small_model
+    config = ServeConfig(
+        fault=FaultConfig(
+            lease_ttl_s=5.0,  # leases never expire: only deadlines catch it
+            heartbeat_s=0.1,
+            task_deadline_s=0.5,
+            watchdog_period_s=0.05,
+            startup_wait_s=2.0,
+            max_retries=4,
+            quarantine_strikes=2,
+        )
+    )
+    with ServingPipeline(
+        plan, variables, devices=devices[:3], config=config
+    ) as pipe:
+        pipe.warmup(x)
+        victim = pipe.workers[0]
+        victim.kill("hang")
+        # Requests keep completing despite the hang (watchdog re-dispatch).
+        for _ in range(4):
+            y = pipe.infer(x, timeout=30.0)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(g.apply(variables, x)), rtol=1e-5
+        )
+        assert victim.worker_id in pipe.dispatcher._quarantined
+        assert (
+            global_metrics().counter("dispatcher.quarantined") >= 1
+        )
+        # Quarantined worker is skipped while healthy workers exist.
+        w = pipe.dispatcher._acquire(0, exclude=set())
+        assert w.worker_id != victim.worker_id
