@@ -25,8 +25,10 @@ MSG_RESULT = 3
 MSG_ACK = 4
 MSG_ERROR = 5
 
-#: header: type, stage_index, request_id, attempt
-_HEADER = struct.Struct(">BIQI")
+#: header: type, stage_index (signed: canary probes use PING_STAGE = -1),
+#: request_id (signed: probe ids are negative, disjoint from requests),
+#: attempt.
+_HEADER = struct.Struct(">BiqI")
 _LEN = struct.Struct(">Q")
 
 #: The reference's ACK byte (src/dispatcher.py:250-260, src/node.py:52,88).

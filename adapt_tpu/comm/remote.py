@@ -53,6 +53,12 @@ log = get_logger("remote")
 MSG_KILL = 6  # chaos hook for fault-injection tests
 MSG_PING = 7
 MSG_CONFIG_ERR = 8
+#: Dispatcher-initiated canary probe (control.dispatcher watchdog) and its
+#: answer. Distinct from MSG_PING: pings are *server-initiated* transport
+#: heartbeats that only prove the link + ping thread; a probe answer must
+#: round-trip the serve loop itself, so a hung server misses it.
+MSG_PROBE = 9
+MSG_PROBE_ACK = 10
 
 
 # --------------------------------------------------------------------------
@@ -120,13 +126,21 @@ class RemoteStageServer:
 
     def _handle(self, conn: socket.socket) -> None:
         stop_ping = threading.Event()
+        # The ping thread and the serve loop both write this connection;
+        # without a lock a ping frame can land inside a partially-sent
+        # result frame and corrupt the stream.
+        send_lock = threading.Lock()
+
+        def reply(msg: Message) -> None:
+            with send_lock:
+                send_msg(conn, msg)
 
         def ping_loop():
             while not stop_ping.wait(self.heartbeat_s):
                 if self._crashed:
                     return
                 try:
-                    send_msg(conn, Message(MSG_PING, 0, 0, 0, b""))
+                    reply(Message(MSG_PING, 0, 0, 0, b""))
                 except OSError:
                     return
 
@@ -140,26 +154,34 @@ class RemoteStageServer:
                     weights = msg.payload[4 + hlen :]
                     try:
                         self._build_stage(cfg, weights)
-                        send_msg(
-                            conn,
-                            Message(MSG_ACK, msg.stage_index, 0, 0, b""),
-                        )
+                        reply(Message(MSG_ACK, msg.stage_index, 0, 0, b""))
                     except Exception as e:  # noqa: BLE001
                         log.error("remote configure failed: %s", e)
-                        send_msg(
-                            conn,
+                        reply(
                             Message(
                                 MSG_CONFIG_ERR,
                                 msg.stage_index,
                                 0,
                                 0,
                                 str(e).encode(),
-                            ),
+                            )
                         )
                 elif msg.msg_type == MSG_DATA:
                     if self._hung:
                         continue  # swallow; watchdog must recover
-                    self._execute(conn, msg)
+                    self._execute(reply, msg)
+                elif msg.msg_type == MSG_PROBE:
+                    if self._hung:
+                        continue  # swallow like data; probe deadline fires
+                    reply(
+                        Message(
+                            MSG_PROBE_ACK,
+                            msg.stage_index,
+                            msg.request_id,
+                            msg.attempt,
+                            b"",
+                        )
+                    )
                 elif msg.msg_type == MSG_KILL:
                     mode = msg.payload.decode()
                     log.warning("remote worker kill: %s", mode)
@@ -174,7 +196,7 @@ class RemoteStageServer:
             stop_ping.set()
             conn.close()
 
-    def _execute(self, conn: socket.socket, msg: Message) -> None:
+    def _execute(self, reply, msg: Message) -> None:
         try:
             entry = self._stages.get(msg.stage_index)
             if entry is None:
@@ -184,22 +206,20 @@ class RemoteStageServer:
             y = fn(variables, jax.device_put(x, self.device))
             y.block_until_ready()
             out = codec_lib.pack(self._codec, np.asarray(y))
-            send_msg(
-                conn,
+            reply(
                 Message(
                     MSG_RESULT, msg.stage_index, msg.request_id, msg.attempt, out
-                ),
+                )
             )
         except Exception as e:  # noqa: BLE001
-            send_msg(
-                conn,
+            reply(
                 Message(
                     MSG_ERROR,
                     msg.stage_index,
                     msg.request_id,
                     msg.attempt,
                     str(e).encode(),
-                ),
+                )
             )
 
     def serve_forever(self) -> None:
@@ -347,6 +367,30 @@ class RemoteWorkerProxy:
         self._configured.add(stage_index)
 
     def submit(self, task) -> None:
+        if task.stage_index < 0:
+            # Canary probe (control.dispatcher watchdog): no payload, no
+            # in-flight accounting — the dispatcher tracks it in _probes.
+            # Bounded lock wait: the watchdog thread calls this, and it
+            # must never block behind a configure() holding _send_lock
+            # across a multi-hundred-MB weights send to a wedged peer.
+            if not self._send_lock.acquire(timeout=1.0):
+                raise TimeoutError(
+                    f"{self.worker_id} send channel busy; probe dropped"
+                )
+            try:
+                send_msg(
+                    self._sock,
+                    Message(
+                        MSG_PROBE,
+                        task.stage_index,
+                        task.request_id,
+                        task.attempt,
+                        b"",
+                    ),
+                )
+            finally:
+                self._send_lock.release()
+            return
         payload = codec_lib.pack(self._codec, np.asarray(task.payload))
         with self._count_lock:
             self._inflight_count += 1
@@ -377,6 +421,15 @@ class RemoteWorkerProxy:
             if msg.msg_type == MSG_PING:
                 self._registry.heartbeat(
                     self.worker_id, ttl_s=self._fault.lease_ttl_s
+                )
+            elif msg.msg_type == MSG_PROBE_ACK:
+                self._results.put(
+                    TaskResult(
+                        request_id=msg.request_id,
+                        stage_index=msg.stage_index,
+                        attempt=msg.attempt,
+                        worker_id=self.worker_id,
+                    )
                 )
             elif msg.msg_type == MSG_ACK:
                 ev = self._config_acks.get(msg.stage_index)

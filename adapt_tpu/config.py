@@ -35,6 +35,14 @@ class FaultConfig:
     # Deadline misses before a still-heartbeating worker (a hang) is
     # quarantined — scheduler stops acquiring it except as last resort.
     quarantine_strikes: int = 2
+    # Canary probing: a worker that has been silent (no completed task or
+    # probe) longer than this window receives a lightweight ping task; a
+    # ping that misses the task deadline counts as a strike. This is how a
+    # hung-but-heartbeating worker accrues strikes even when the scheduler
+    # routes real traffic away from it (rank demotes struck workers), so
+    # quarantine stays reachable. None -> task_deadline_s. Set very large
+    # to disable probing.
+    probe_silence_s: float | None = None
     # Worker-configuration handshake timeout; reference: connect 5 s /
     # ACK 60 s (dispatcher.py:226,250-260).
     configure_timeout_s: float = 60.0

@@ -33,7 +33,13 @@ import jax
 
 from adapt_tpu.config import ServeConfig
 from adapt_tpu.control.registry import WorkerRegistry
-from adapt_tpu.control.worker import StageWorker, Task, TaskResult, WorkerState
+from adapt_tpu.control.worker import (
+    PING_STAGE,
+    StageWorker,
+    Task,
+    TaskResult,
+    WorkerState,
+)
 from adapt_tpu.graph.partition import PartitionPlan
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
@@ -142,14 +148,40 @@ class Dispatcher:
         # expiry; after `quarantine_strikes` deadline misses the scheduler
         # stops acquiring it (the reference's socket-error eviction,
         # src/dispatcher.py:153-161, generalized to hangs).
+        #
+        # How strikes accrue once rank demotes a struck worker (and real
+        # traffic stops reaching it): the watchdog sends canary *probe*
+        # tasks (PING_STAGE) to any alive worker that has been silent
+        # beyond the probe window; a probe that misses the task deadline is
+        # a strike like any other. Probes also self-heal: an answered probe
+        # forgives probe-miss strikes (and, under quarantine, slowly decays
+        # real-task strikes), so a recovered worker returns to service.
+        #
+        # _health_lock guards all four maps below — they are touched from
+        # the result loop, the watchdog, and the forward pool concurrently.
+        self._health_lock = threading.Lock()
         self._strikes: dict[str, int] = {}
+        # Of those, the strikes earned by probe misses: an answered probe
+        # forgives only these — a ping proves the exec loop drains, not
+        # that the worker completes real tasks in time, so real-task
+        # deadline strikes persist until a timely real completion.
+        self._probe_strikes: dict[str, int] = {}
         self._quarantined: set[str] = set()
-        # Liveness evidence: worker_id -> monotonic time of its last
-        # successful result. Rank trusts recently-proven workers over
-        # attractive-looking silent ones (a hung worker looks idle and
-        # configured forever).
+        # worker_id -> monotonic time of its last completed task or probe.
         self._last_ok: dict[str, float] = {}
-        self._rng = random.Random(0x5EED)
+        # worker_id -> (probe request_id, send time) for in-flight probes.
+        self._probes: dict[str, tuple[int, float]] = {}
+        # worker_id -> most recent probe id ever sent: only the *latest*
+        # probe's answer earns forgiveness/decay, so a long-hung worker's
+        # backlog of queued pings cannot, on revive, replay as a burst
+        # that drains accumulated real-task strikes in one tick.
+        self._last_probe_id: dict[str, int] = {}
+        self._probe_ids = itertools.count(-2, -1)  # never a request id
+        self._boot_time = time.monotonic()
+        # Tie-break shuffle runs on forward-pool threads concurrently and
+        # random.Random is not thread-safe -> one RNG per thread.
+        self._tls = threading.local()
+        self._rng_seeds = itertools.count(0x5EED)
         # Forward/re-dispatch pool: _acquire can block on a weight transfer
         # (configure), which must never stall the result loop or the
         # registry reaper (the reference likewise forwards in spawned
@@ -378,10 +410,13 @@ class Dispatcher:
             ]
         if not pool:
             raise RequestFailed("no live workers")
+        with self._health_lock:
+            strikes = dict(self._strikes)
+            quarantined = set(self._quarantined)
         # Preference cascade: healthy & untried > quarantined & untried
         # (quarantine is a soft signal; a worker this request hasn't tried
         # yet still beats re-picking one that just failed it) > anyone.
-        healthy = [w for w in pool if w.worker_id not in self._quarantined]
+        healthy = [w for w in pool if w.worker_id not in quarantined]
         candidates = (
             [w for w in healthy if w.worker_id not in exclude]
             or [w for w in pool if w.worker_id not in exclude]
@@ -389,20 +424,16 @@ class Dispatcher:
             or pool
         )
 
-        now = time.monotonic()
-        recent_window = self.config.fault.task_deadline_s
-
         def rank(w: StageWorker):
             return (
                 # Any missed deadline (even below the quarantine threshold)
                 # demotes a worker: a hung worker looks perfectly idle and
-                # configured — the most attractive rank — so strike feedback
-                # must outweigh attractiveness.
-                min(self._strikes.get(w.worker_id, 0), 1),
-                # Proven liveness beats attractiveness: a worker that
-                # completed something within one deadline window outranks
-                # one that has been silent (hung workers are silent).
-                0 if now - self._last_ok.get(w.worker_id, -1e9) < recent_window else 1,
+                # configured — the most attractive rank — so strike
+                # feedback must outweigh attractiveness. Workers with NO
+                # strikes stay fully schedulable (the watchdog's canary
+                # probes, not scheduling starvation, are what detect a
+                # silent hang — see _watchdog_loop).
+                1 if strikes.get(w.worker_id, 0) else 0,
                 0 if w.is_configured(stage_index) else 1,
                 0 if w.state is WorkerState.IDLE else 1,
                 w.queue_depth,
@@ -411,7 +442,7 @@ class Dispatcher:
         # Random tie-break: concurrent re-dispatch waves must scatter over
         # equal-rank candidates, not herd onto one deterministic victim
         # (which would burn one deadline per worker, serially).
-        self._rng.shuffle(candidates)
+        self._shuffle(candidates)
         last_error: Exception | None = None
         for worker in sorted(candidates, key=rank):
             if worker.is_configured(stage_index):
@@ -431,6 +462,12 @@ class Dispatcher:
             f"no worker could be configured for stage {stage_index}: "
             f"{last_error}"
         )
+
+    def _shuffle(self, seq: list) -> None:
+        rng = getattr(self._tls, "rng", None)
+        if rng is None:
+            rng = self._tls.rng = random.Random(next(self._rng_seeds))
+        rng.shuffle(seq)
 
     def _configure_with_timeout(
         self, worker: StageWorker, stage_index: int
@@ -582,6 +619,53 @@ class Dispatcher:
             result = self.result_queue.get()
             if result is None:
                 break
+            if result.stage_index < 0:
+                # Probe (canary) answer: proof the exec loop is draining
+                # again — even a stale ping from before a re-probe counts.
+                # Forgives probe-miss strikes (a lifted hang) but not
+                # real-task deadline strikes, and lifts quarantine only if
+                # what remains is below the threshold. Ignored entirely if
+                # the worker has left membership since (a rejoin under the
+                # same id must start with a clean slate).
+                wid = result.worker_id
+                if wid not in self.registry.alive():
+                    global_metrics().inc("dispatcher.probes_ignored")
+                    continue
+                with self._health_lock:
+                    self._last_ok[wid] = time.monotonic()
+                    if result.request_id != self._last_probe_id.get(wid):
+                        # Stale ping from a revive-burst: liveness proof
+                        # (recorded above) but no forgiveness — only the
+                        # newest probe's answer absolves, one per
+                        # round-trip actually sent.
+                        global_metrics().inc("dispatcher.probes_ok")
+                        continue
+                    self._probes.pop(wid, None)
+                    forgiven = self._probe_strikes.pop(wid, 0)
+                    remaining = self._strikes.get(wid, 0) - forgiven
+                    remaining = max(remaining, 0)
+                    if (
+                        wid in self._quarantined
+                        and remaining >= self.config.fault.quarantine_strikes
+                    ):
+                        # Quarantine earned from real-task strikes, whose
+                        # late results were dropped as stale and so can
+                        # never absolve: each answered probe decays one
+                        # real strike, so a transiently-stalled worker
+                        # works its way back (to demoted-but-available,
+                        # not to full trust) instead of being sidelined
+                        # forever. Decay only applies under quarantine —
+                        # a merely-demoted slow worker must NOT oscillate
+                        # back to full rank on probe answers alone.
+                        remaining -= 1
+                    if remaining > 0:
+                        self._strikes[wid] = remaining
+                    else:
+                        self._strikes.pop(wid, None)
+                    if remaining < self.config.fault.quarantine_strikes:
+                        self._quarantined.discard(wid)
+                global_metrics().inc("dispatcher.probes_ok")
+                continue
             with self._inflight_lock:
                 entry = self._inflight.get(result.request_id)
                 if (
@@ -602,10 +686,12 @@ class Dispatcher:
             # A successful result clears the worker's strike record — a
             # transient stall (queue backlog, first compile) must not
             # sideline a healthy worker forever — and refreshes its
-            # liveness evidence for rank.
-            self._last_ok[result.worker_id] = time.monotonic()
-            if self._strikes.pop(result.worker_id, None) is not None:
-                self._quarantined.discard(result.worker_id)
+            # liveness evidence (which defers the watchdog's probes).
+            with self._health_lock:
+                self._last_ok[result.worker_id] = time.monotonic()
+                self._probe_strikes.pop(result.worker_id, None)
+                if self._strikes.pop(result.worker_id, None) is not None:
+                    self._quarantined.discard(result.worker_id)
             next_stage = result.stage_index + 1
             if next_stage < self.plan.num_stages:
                 self._forward_pool.submit(
@@ -618,54 +704,140 @@ class Dispatcher:
                 f"stage{result.stage_index}.latency_s", stage_latency
             )
 
+    def _add_strike_locked(
+        self, worker_id: str, from_probe: bool = False
+    ) -> bool:
+        """Record one missed deadline (caller holds ``_health_lock``);
+        returns True when this strike crosses the quarantine threshold."""
+        strikes = self._strikes.get(worker_id, 0) + 1
+        self._strikes[worker_id] = strikes
+        if from_probe:
+            self._probe_strikes[worker_id] = (
+                self._probe_strikes.get(worker_id, 0) + 1
+            )
+        newly_quarantined = (
+            strikes >= self.config.fault.quarantine_strikes
+            and worker_id not in self._quarantined
+        )
+        if newly_quarantined:
+            self._quarantined.add(worker_id)
+        return newly_quarantined
+
+    def _quarantine_drain(self, worker_id: str, why: str) -> None:
+        """A just-quarantined worker's other in-flight tasks are almost
+        certainly doomed too — re-dispatch them now instead of one
+        deadline at a time."""
+        global_metrics().inc("dispatcher.quarantined")
+        log.warning("worker %s quarantined (%s)", worker_id, why)
+        with self._inflight_lock:
+            doomed = [
+                e for e in self._inflight.values() if e.worker_id == worker_id
+            ]
+            for e in doomed:
+                del self._inflight[e.request_id]
+        for e in doomed:
+            self._forward_pool.submit(
+                self._redispatch, e, "co-resident with quarantine"
+            )
+
+    def _add_strike(self, worker_id: str, why: str) -> None:
+        with self._health_lock:
+            newly_quarantined = self._add_strike_locked(worker_id)
+        if newly_quarantined:
+            self._quarantine_drain(worker_id, why)
+
+    def _probe_silent_workers(self, now: float, deadline: float) -> None:
+        """Canary liveness probes: a hung worker heartbeats (so membership
+        keeps it) and, once struck, is rank-demoted (so real traffic stops
+        reaching it) — probes are the only way further strikes can accrue
+        and quarantine stays reachable. Conversely, a recovered worker's
+        answered probe lifts its quarantine (see _result_loop)."""
+        silence = self.config.fault.probe_silence_s
+        if silence is None:
+            silence = self.config.fault.task_deadline_s
+        # Expire overdue probes first: each costs one strike. Detection
+        # and strike are one atomic critical section, so an answer racing
+        # in through the result loop either lands before (probe entry gone,
+        # no strike) or after (forgives the probe strike it just earned).
+        with self._health_lock:
+            missed = [
+                wid
+                for wid, (_, sent) in self._probes.items()
+                if now - sent > deadline
+            ]
+            quarantine_now = []
+            for wid in missed:
+                del self._probes[wid]
+                if self._add_strike_locked(wid, from_probe=True):
+                    quarantine_now.append(wid)
+        for wid in missed:
+            global_metrics().inc("dispatcher.probes_missed")
+        for wid in quarantine_now:
+            self._quarantine_drain(wid, "probe missed")
+        alive = set(self.registry.alive())
+        with self._workers_lock:
+            pool = [
+                w
+                for wid, w in self._workers.items()
+                if wid in alive and w.state is not WorkerState.DEAD
+            ]
+        for w in pool:
+            with self._health_lock:
+                if w.worker_id in self._probes:
+                    continue
+                last = self._last_ok.get(w.worker_id, self._boot_time)
+                if now - last <= silence:
+                    continue
+                pid = next(self._probe_ids)
+                self._probes[w.worker_id] = (pid, now)
+                self._last_probe_id[w.worker_id] = pid
+            try:
+                w.submit(
+                    Task(
+                        request_id=pid,
+                        stage_index=PING_STAGE,
+                        attempt=0,
+                        payload=None,
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 — e.g. remote socket gone
+                # An unsendable probe is not a strike: a dead link stops
+                # the proxy's lease renewals, so membership eviction (not
+                # the probe path) retires the worker.
+                with self._health_lock:
+                    self._probes.pop(w.worker_id, None)
+                log.warning("probe send to %s failed: %s", w.worker_id, e)
+                continue
+            global_metrics().inc("dispatcher.probes_sent")
+
     def _watchdog_loop(self) -> None:
         """Deadline scan over the in-flight registry (the reference's
         ``_task_watchdog``, ``src/dispatcher.py:302-304``, body lost —
-        rebuilt here)."""
+        rebuilt here), plus canary probing of silent workers."""
         period = self.config.fault.watchdog_period_s
         deadline = self.config.fault.task_deadline_s
         while not self._shutdown.wait(period):
             if self._watchdog_paused:
                 continue
-            now = time.monotonic()
-            overdue: list[_Inflight] = []
-            with self._inflight_lock:
-                for rid, entry in list(self._inflight.items()):
-                    if now - entry.start_time > deadline:
-                        overdue.append(entry)
-                        del self._inflight[rid]
-            for entry in overdue:
-                strikes = self._strikes.get(entry.worker_id, 0) + 1
-                self._strikes[entry.worker_id] = strikes
-                if (
-                    strikes >= self.config.fault.quarantine_strikes
-                    and entry.worker_id not in self._quarantined
-                ):
-                    self._quarantined.add(entry.worker_id)
-                    global_metrics().inc("dispatcher.quarantined")
-                    log.warning(
-                        "worker %s quarantined after %d missed deadlines",
-                        entry.worker_id,
-                        strikes,
+            # The watchdog is the single recovery mechanism for hangs; it
+            # must outlive any per-iteration surprise (a worker interface
+            # raising, a registry hiccup) — skip the tick, never die.
+            try:
+                now = time.monotonic()
+                overdue: list[_Inflight] = []
+                with self._inflight_lock:
+                    for rid, entry in list(self._inflight.items()):
+                        if now - entry.start_time > deadline:
+                            overdue.append(entry)
+                            del self._inflight[rid]
+                for entry in overdue:
+                    self._add_strike(entry.worker_id, "task deadline exceeded")
+                    self._forward_pool.submit(
+                        self._redispatch, entry, "deadline exceeded"
                     )
-                    # Everything else in flight on a just-quarantined
-                    # worker is almost certainly doomed too — drain the
-                    # pile-up now instead of one deadline at a time.
-                    with self._inflight_lock:
-                        doomed = [
-                            e
-                            for e in self._inflight.values()
-                            if e.worker_id == entry.worker_id
-                        ]
-                        for e in doomed:
-                            del self._inflight[e.request_id]
-                    for e in doomed:
-                        self._forward_pool.submit(
-                            self._redispatch, e, "co-resident with quarantine"
-                        )
-                self._forward_pool.submit(
-                    self._redispatch, entry, "deadline exceeded"
-                )
+                self._probe_silent_workers(now, deadline)
+            except Exception:  # noqa: BLE001
+                log.exception("watchdog iteration failed; continuing")
 
     def _on_membership(self, event: str, worker_id: str) -> None:
         """Reference ``_worker_monitor`` (:276): on worker death, don't wait
@@ -678,8 +850,13 @@ class Dispatcher:
             return
         # A departed worker's record dies with it; a future re-join under
         # the same id starts with a clean slate.
-        self._strikes.pop(worker_id, None)
-        self._quarantined.discard(worker_id)
+        with self._health_lock:
+            self._strikes.pop(worker_id, None)
+            self._probe_strikes.pop(worker_id, None)
+            self._quarantined.discard(worker_id)
+            self._last_ok.pop(worker_id, None)
+            self._probes.pop(worker_id, None)
+            self._last_probe_id.pop(worker_id, None)
         with self._inflight_lock:
             orphaned = [
                 e for e in self._inflight.values() if e.worker_id == worker_id

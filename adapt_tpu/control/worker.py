@@ -44,6 +44,13 @@ class WorkerState(enum.Enum):
     DEAD = "dead"
 
 
+#: Sentinel stage index for liveness-probe (canary) tasks: the worker
+#: answers immediately without touching any stage binding. A hung worker's
+#: exec loop swallows the ping exactly like a real task — that is the
+#: signal the dispatcher's watchdog turns into a strike.
+PING_STAGE = -1
+
+
 @dataclass
 class Task:
     """One stage-execution request (reference: 4-byte stage index + framed
@@ -141,6 +148,12 @@ class StageWorker:
         else:
             raise ValueError(f"unknown kill mode {mode!r}")
 
+    def revive(self) -> None:
+        """Chaos hook: clear an injected hang. The exec loop resumes
+        draining its inbox — including any queued canary probes, whose
+        answers lift the dispatcher's quarantine (self-healing)."""
+        self._hung.clear()
+
     # -- dispatcher-facing API ----------------------------------------------
 
     @property
@@ -201,6 +214,19 @@ class StageWorker:
             if self._hung.is_set():
                 # Hung worker: swallow the task, never reply. The
                 # dispatcher's watchdog must recover it.
+                continue
+            if task.stage_index < 0:
+                # Liveness probe: answer without executing anything. Must
+                # flow through this loop (not a side channel) so a blocked
+                # exec loop fails the probe the way it fails real tasks.
+                self._results.put(
+                    TaskResult(
+                        request_id=task.request_id,
+                        stage_index=task.stage_index,
+                        attempt=task.attempt,
+                        worker_id=self.worker_id,
+                    )
+                )
                 continue
             with self._state_lock:
                 self._state = WorkerState.BUSY

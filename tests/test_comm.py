@@ -123,6 +123,23 @@ def test_framing_peer_close_raises():
     b.close()
 
 
+def test_framing_negative_ids_roundtrip():
+    """Canary probes carry stage_index = PING_STAGE (-1) and negative
+    request ids — the header must be signed (regression: '>BIQI' raised
+    struct.error and killed the dispatcher's watchdog thread)."""
+    a, b = socket.socketpair()
+    try:
+        msg = Message(MSG_DATA, -1, -7, 0, b"")
+        t = threading.Thread(target=send_msg, args=(a, msg))
+        t.start()
+        got = recv_msg(b)
+        t.join()
+        assert got == msg
+    finally:
+        a.close()
+        b.close()
+
+
 # -- remote worker end-to-end ----------------------------------------------
 
 
@@ -212,3 +229,65 @@ def test_remote_worker_full_pipeline(remote_worker_proc, devices):
         assert len(outs2) == 2
     finally:
         disp.shutdown()
+
+
+def test_remote_probe_roundtrip_and_hang_swallow():
+    """The dispatcher's canary probes must round-trip the remote serve
+    loop (not just the transport ping thread): a healthy server answers a
+    PING_STAGE task; a hung server swallows it so the probe deadline can
+    fire. Regression for probes crashing on the remote submit path."""
+    import queue as queue_mod
+
+    from adapt_tpu.comm.remote import RemoteWorkerProxy
+    from adapt_tpu.config import FaultConfig
+    from adapt_tpu.control.registry import WorkerRegistry
+    from adapt_tpu.control.worker import PING_STAGE, Task
+
+    port = 17593
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "adapt_tpu.comm.remote", "--port", str(port),
+         "--heartbeat", "0.1"],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    registry = WorkerRegistry(default_ttl_s=2.0).start()
+    results: "queue_mod.Queue" = queue_mod.Queue()
+    proxy = RemoteWorkerProxy(
+        "remote-probe",
+        ("127.0.0.1", port),
+        registry,
+        results,
+        model_config={},
+        fault=FaultConfig(startup_wait_s=10.0),
+    )
+    try:
+        proxy.start()
+        probe = Task(
+            request_id=-5, stage_index=PING_STAGE, attempt=0, payload=None
+        )
+        proxy.submit(probe)
+        ans = results.get(timeout=5.0)
+        assert ans.stage_index == PING_STAGE
+        assert ans.request_id == -5
+        assert ans.worker_id == "remote-probe"
+        assert ans.error is None
+        # Probes must not count as in-flight work on the proxy.
+        assert proxy.queue_depth == 0
+        proxy.kill("hang")
+        time.sleep(0.2)
+        proxy.submit(
+            Task(request_id=-6, stage_index=PING_STAGE, attempt=0, payload=None)
+        )
+        with pytest.raises(queue_mod.Empty):
+            results.get(timeout=1.5)
+    finally:
+        proxy.stop()
+        registry.stop()
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -6,6 +6,7 @@ This is the test coverage the reference never had for its headline feature
 with no lost or duplicated requests.
 """
 
+import dataclasses
 import time
 
 import flax.linen as nn
@@ -290,21 +291,30 @@ def test_throughput_empty_inputs(small_model, devices):
 
 def test_hung_worker_still_scheduled_and_recovered(small_model, devices):
     """A hung worker stays schedulable (it heartbeats like a healthy one);
-    requests routed to it must be recovered by the deadline watchdog —
-    the true _task_watchdog path (regression: hang used to self-advertise
-    as DEAD and dodge scheduling)."""
+    a request routed to it must be recovered by the deadline watchdog —
+    the true _task_watchdog path. Deterministic force-route: only the
+    victim is configured for any stage, so configured-first rank must pick
+    it for the first dispatch; canary probes are disabled so recovery can
+    only come from the real-task deadline."""
     g, variables, plan, x = small_model
     global_metrics().reset()
-    cfg = ServeConfig(max_inflight=2, fault=FAST_FAULT)
+    fault = dataclasses.replace(FAST_FAULT, probe_silence_s=600.0)
+    cfg = ServeConfig(max_inflight=2, fault=fault)
     pipe = ServingPipeline(plan, variables, devices[:2], cfg)
     with pipe:
-        pipe.infer(x)  # configure both workers
+        victim = pipe.workers[0]
+        for s in range(plan.num_stages):
+            pipe.dispatcher._configure_with_timeout(victim, s)
         pipe.kill_worker(0, mode="hang")
         from adapt_tpu.control.worker import WorkerState
 
-        assert pipe.workers[0].state is not WorkerState.DEAD
+        assert victim.state is not WorkerState.DEAD
         outs = pipe.stream([x] * 4, timeout_per_request=30.0)
         assert len(outs) == 4
+        for y in outs:
+            np.testing.assert_allclose(
+                np.asarray(y), np.asarray(g.apply(variables, x)), rtol=1e-5
+            )
     m = global_metrics().snapshot()["counters"]
     # The hung worker swallowed at least one task -> watchdog re-dispatched.
     assert m.get("dispatcher.redispatched", 0) >= 1
@@ -379,9 +389,16 @@ def test_hung_worker_quarantined_after_strikes(small_model, devices):
         pipe.warmup(x)
         victim = pipe.workers[0]
         victim.kill("hang")
-        # Requests keep completing despite the hang (watchdog re-dispatch).
-        for _ in range(4):
+        # Serving continues throughout; strikes accrue against the hung
+        # worker from real-task deadline misses and — deterministically,
+        # even when rank routes all real traffic away from it — from the
+        # watchdog's canary probes, until quarantine.
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
             y = pipe.infer(x, timeout=30.0)
+            with pipe.dispatcher._health_lock:
+                if victim.worker_id in pipe.dispatcher._quarantined:
+                    break
         np.testing.assert_allclose(
             np.asarray(y), np.asarray(g.apply(variables, x)), rtol=1e-5
         )
@@ -392,3 +409,14 @@ def test_hung_worker_quarantined_after_strikes(small_model, devices):
         # Quarantined worker is skipped while healthy workers exist.
         w = pipe.dispatcher._acquire(0, exclude=set())
         assert w.worker_id != victim.worker_id
+        # Self-healing: once the hang clears, the queued/next canary probe
+        # is answered and the quarantine lifts without operator action.
+        victim.revive()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            with pipe.dispatcher._health_lock:
+                if victim.worker_id not in pipe.dispatcher._quarantined:
+                    break
+            time.sleep(0.05)
+        with pipe.dispatcher._health_lock:
+            assert victim.worker_id not in pipe.dispatcher._quarantined
