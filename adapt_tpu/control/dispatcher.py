@@ -788,6 +788,13 @@ class Dispatcher:
                 last = self._last_ok.get(w.worker_id, self._boot_time)
                 if now - last <= silence:
                     continue
+                if w.worker_id not in self.registry.alive():
+                    # Evicted since the pool snapshot above; inserting now
+                    # would resurrect health state _on_membership('leave')
+                    # just cleared (phantom strikes on rejoin). Safe to
+                    # call alive() here: registry watchers fire outside its
+                    # lock, so health->registry is the only ordering.
+                    continue
                 pid = next(self._probe_ids)
                 self._probes[w.worker_id] = (pid, now)
                 self._last_probe_id[w.worker_id] = pid

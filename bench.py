@@ -6,10 +6,20 @@ here is a single NVIDIA A100's framework-level ResNet-50 fp16 inference
 throughput at bs=32 (~3000 images/sec, XLA/TF-class stacks — TensorRT INT8
 figures are far higher but not framework-comparable).
 
-Measurement methodology: the timed region is ONE jitted program that runs
-ITERS forward passes in a `lax.scan`, with each iteration's input carrying
-a data dependency on the previous iteration's logits. That shape is
-deliberate:
+Robustness contract (round-1 postmortem: the driver-captured run died at
+first JAX op with "Unable to initialize backend 'axon'", rc=1, and zero
+perf numbers existed): this script must ALWAYS print exactly one JSON line
+and exit 0. The parent process imports no JAX; the measurement runs in a
+child subprocess under a hard timeout (backend init through the TPU tunnel
+can HANG, not just raise — a timeout is the only reliable guard). TPU is
+attempted with retry + backoff; if it never comes up, a CPU-backend
+fallback still produces a measured number, flagged "platform": "cpu" with
+the TPU failure tail in "note" so the regression is loud, not silent.
+
+Measurement methodology (child): the timed region is ONE jitted program
+that runs ITERS forward passes in a `lax.scan`, with each iteration's
+input carrying a data dependency on the previous iteration's logits. That
+shape is deliberate:
 - a Python-level dispatch loop under this image's remote-execution tunnel
   over-reports wildly (repeat executions of identical (fn, args) are
   deduplicated, and `block_until_ready` returns before execution
@@ -19,24 +29,40 @@ deliberate:
 Wall clock is taken around a host fetch (`np.asarray`) of the scalar
 result, which is the only operation that provably waits for execution.
 
+MFU is reported alongside (VERDICT r1 #1): images/sec x ~8.2 GFLOP/image
+(ResNet-50 fwd, multiply+add counted separately) / chip peak bf16 FLOPs
+(TPU v5e: 197 TFLOP/s).
+
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 """
 
 from __future__ import annotations
 
 import json
+import os
 import statistics
+import subprocess
 import sys
 import time
 
 A100_IMAGES_PER_SEC = 3000.0  # single-A100 fp16 bs32, framework-level
+RESNET50_FLOPS_PER_IMAGE = 8.2e9  # fwd pass @224x224, mul+add as 2
+TPU_V5E_PEAK_FLOPS = 197e12  # bf16
 BATCH = 32
-ITERS = 100  # forwards per timed program; amortizes the tunnel round-trip
-TRIALS = 5
+
+#: (platform, iters, trials, timeout_s, backoff_before_s). TPU gets two
+#: shots (first compile through the tunnel is slow; a flaky relay often
+#: recovers within a minute); CPU is the evidence-of-life fallback with a
+#: small iteration count — ResNet-50 bs=32 on CPU is ~seconds per batch.
+ATTEMPTS = [
+    ("tpu", 100, 5, 600, 0),
+    ("tpu", 100, 3, 420, 30),
+    ("cpu", 3, 2, 600, 0),
+]
 
 
-def main() -> None:
+def _child(platform: str, iters: int, trials: int) -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -59,14 +85,14 @@ def main() -> None:
             x = x * 0.999 + (jnp.mean(y) * 1e-6).astype(x.dtype)
             return x, y[0, 0]
 
-        x, ys = lax.scan(body, x, None, length=ITERS)
+        x, ys = lax.scan(body, x, None, length=iters)
         return jnp.mean(ys)
 
     fwd = jax.jit(bench_fn)
     np.asarray(fwd(variables, x0))  # compile + warm
 
     times = []
-    for i in range(TRIALS):
+    for i in range(trials):
         # Distinct input per trial: the tunnel dedups repeat executions of
         # identical (fn, args), which would serve trials from cache.
         x_trial = x0 + (i + 1) * 1e-6
@@ -75,17 +101,120 @@ def main() -> None:
         times.append(time.perf_counter() - t0)
 
     dt = statistics.median(times)
-    images_per_sec = BATCH * ITERS / dt
+    images_per_sec = BATCH * iters / dt
+    record = {
+        "metric": "resnet50_bs32_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / A100_IMAGES_PER_SEC, 4),
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+        "batch": BATCH,
+        "iters": iters,
+        "trials": trials,
+        "trial_seconds": [round(t, 4) for t in times],
+    }
+    # Gate MFU on the MEASURED platform, not the requested one: if JAX
+    # silently fell back to CPU, an "mfu" vs TPU peak would be fabricated.
+    if record["platform"] != "cpu":
+        record["mfu"] = round(
+            images_per_sec * RESNET50_FLOPS_PER_IMAGE / TPU_V5E_PEAK_FLOPS, 4
+        )
+    print(json.dumps(record), flush=True)
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        platform = sys.argv[sys.argv.index("--platform") + 1]
+        iters = int(sys.argv[sys.argv.index("--iters") + 1])
+        trials = int(sys.argv[sys.argv.index("--trials") + 1])
+        _child(platform, iters, trials)
+        return 0
+
+    notes: list[str] = []
+    for platform, iters, trials, timeout_s, backoff_s in ATTEMPTS:
+        if backoff_s:
+            time.sleep(backoff_s)
+        env = dict(os.environ)
+        if platform == "cpu":
+            # Drop the axon relay hook: with the TPU tunnel down, imports
+            # through it hang; the CPU run must be hermetic.
+            env.pop("PYTHONPATH", None)
+            env["JAX_PLATFORMS"] = "cpu"
+        cmd = [
+            sys.executable,
+            os.path.abspath(__file__),
+            "--child",
+            "--platform",
+            platform,
+            "--iters",
+            str(iters),
+            "--trials",
+            str(trials),
+        ]
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                cmd,
+                env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            notes.append(f"{platform}: timeout after {timeout_s}s")
+            print(
+                f"bench attempt on {platform} timed out ({timeout_s}s)",
+                file=sys.stderr,
+            )
+            continue
+        if proc.returncode == 0:
+            record = None
+            for line in proc.stdout.splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        record = json.loads(line)
+                        break
+                    except json.JSONDecodeError:
+                        continue
+            if record is None:
+                notes.append(f"{platform}: exited 0 but printed no JSON")
+            elif platform == "tpu" and record.get("platform") == "cpu":
+                # JAX silently fell back to CPU inside a TPU attempt —
+                # reject it; a real (flagged) CPU fallback is the last
+                # attempt's job.
+                notes.append("tpu attempt silently ran on cpu")
+            else:
+                if notes:
+                    record["note"] = "; ".join(notes)
+                print(json.dumps(record), flush=True)
+                return 0
+        else:
+            tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+            tail = " | ".join(tail[-3:])[-500:]
+            notes.append(
+                f"{platform}: rc={proc.returncode} after "
+                f"{time.time() - t0:.0f}s: {tail}"
+            )
+            print(f"bench attempt on {platform} failed: {tail}", file=sys.stderr)
+
+    # Every attempt failed: still honor the one-JSON-line, rc=0 contract so
+    # the driver records a diagnostic instead of a crash.
     print(
         json.dumps(
             {
                 "metric": "resnet50_bs32_images_per_sec_per_chip",
-                "value": round(images_per_sec, 2),
+                "value": 0.0,
                 "unit": "images/sec",
-                "vs_baseline": round(images_per_sec / A100_IMAGES_PER_SEC, 4),
+                "vs_baseline": 0.0,
+                "error": "; ".join(notes)[-1000:],
             }
-        )
+        ),
+        flush=True,
     )
+    return 0
 
 
 if __name__ == "__main__":
