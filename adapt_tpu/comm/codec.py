@@ -16,6 +16,16 @@ boundary. Offered codecs:
              (``native/qcodec.cpp``) — the closest analog of the
              reference's zfp+lz4 stack, with a user tolerance like zfp's
              accuracy mode.
+- ``lz``:    LOSSLESS native LZ77 over the raw bytes — the lz4-frame
+             analog (the reference wraps every payload in lz4,
+             ``src/dispatcher.py:92-93``); the default for *weights*,
+             where lossy codecs are off the table.
+- ``int8dev``: blockwise int8 via the on-device Pallas kernel
+             (``ops/quantize.py``) — quantizes in VMEM *before* the
+             host fetch, so the device->host copy itself is 4x smaller
+             (SURVEY.md §2.3 "on-device quantization at DCN
+             boundaries"). Host-side codecs above shrink only the wire;
+             this one shrinks the PCIe/DMA hop too.
 
 All codecs are symmetric: ``decode(*encode(x))`` returns an array of the
 original shape/dtype (within the codec's stated tolerance).
@@ -113,11 +123,75 @@ class ZfpLikeCodec:
         return (q.astype(np.float32) * meta["step"]).astype(meta["dtype"])
 
 
+@dataclass(frozen=True)
+class LzCodec:
+    """Lossless: raw bytes through the native LZ77 compressor. Dtype- and
+    bit-exact, so safe for weights and integer tensors."""
+
+    name: str = "lz"
+
+    def encode(self, x: np.ndarray) -> tuple[bytes, dict]:
+        x = np.ascontiguousarray(x)
+        raw = x.tobytes()
+        return native.compress(raw), _meta(x, raw_len=len(raw))
+
+    def decode(self, blob: bytes, meta: dict) -> np.ndarray:
+        raw = native.decompress(blob, meta["raw_len"])
+        return np.frombuffer(raw, dtype=meta["dtype"]).reshape(meta["shape"])
+
+
+@dataclass(frozen=True)
+class DeviceInt8Codec:
+    """Blockwise int8 quantization executed *on device* (Pallas kernel,
+    ``ops/quantize.py``): the tensor leaves the chip already 4x smaller.
+    Encode accepts a jax.Array (host ndarrays are device_put first);
+    decode dequantizes on the default device and returns a host array."""
+
+    name: str = "int8dev"
+
+    def encode(self, x) -> tuple[bytes, dict]:
+        import jax.numpy as jnp
+
+        from adapt_tpu.ops.quantize import quantize
+
+        arr = x if hasattr(x, "devices") else jnp.asarray(x)
+        qt = quantize(arr)
+        vals = np.asarray(qt.values)  # the 4x-smaller host fetch
+        scales = np.asarray(qt.scales)
+        return vals.tobytes() + scales.tobytes(), {
+            "shape": list(qt.shape),
+            "dtype": str(np.dtype(qt.dtype)),
+            "rows": list(vals.shape),
+            "nblocks": int(scales.shape[0]),
+        }
+
+    def decode(self, blob: bytes, meta: dict) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from adapt_tpu.ops.quantize import QuantizedTensor, dequantize
+
+        rows = tuple(meta["rows"])
+        nvals = rows[0] * rows[1]
+        vals = np.frombuffer(blob[:nvals], dtype=np.int8).reshape(rows)
+        scales = np.frombuffer(blob[nvals:], dtype=np.float32).reshape(
+            meta["nblocks"], 1
+        )
+        qt = QuantizedTensor(
+            jnp.asarray(vals),
+            jnp.asarray(scales),
+            tuple(meta["shape"]),
+            np.dtype(meta["dtype"]),
+        )
+        return np.asarray(dequantize(qt))
+
+
 CODECS: dict[str, Codec] = {
     "none": RawCodec(),
     "bf16": Bf16Codec(),
     "int8": Int8Codec(),
     "zfp": ZfpLikeCodec(),
+    "lz": LzCodec(),
+    "int8dev": DeviceInt8Codec(),
 }
 
 

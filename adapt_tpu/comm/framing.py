@@ -51,10 +51,22 @@ def send_msg(sock: socket.socket, msg: Message) -> None:
     sock.sendall(_LEN.pack(len(header) + len(msg.payload)) + header + msg.payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(
+    sock: socket.socket, n: int, retry_on_timeout: bool = True
+) -> bytes:
     chunks = []
     while n:
-        chunk = sock.recv(min(n, 1 << 20))
+        try:
+            chunk = sock.recv(min(n, 1 << 20))
+        except TimeoutError:
+            if retry_on_timeout:
+                # A socket timeout usually exists to bound *sends* (a
+                # wedged peer with full buffers must not hold a sender
+                # forever). Reads keep the partial frame and retry —
+                # liveness is the lease/watchdog's job, and abandoning
+                # mid-frame would desync the stream.
+                continue
+            raise
         if not chunk:
             raise ConnectionError("peer closed mid-frame")
         chunks.append(chunk)
@@ -62,11 +74,14 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def recv_msg(sock: socket.socket) -> Message:
-    (total,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+def recv_msg(sock: socket.socket, retry_on_timeout: bool = True) -> Message:
+    """``retry_on_timeout=False`` turns the socket's timeout into a hard
+    receive deadline (used where a silent peer must not hold a serial
+    loop — e.g. the gateway's HELLO handshake)."""
+    (total,) = _LEN.unpack(_recv_exact(sock, _LEN.size, retry_on_timeout))
     if total < _HEADER.size:
         raise ConnectionError(f"short frame: {total}")
-    buf = _recv_exact(sock, total)
+    buf = _recv_exact(sock, total, retry_on_timeout)
     msg_type, stage_index, request_id, attempt = _HEADER.unpack(
         buf[: _HEADER.size]
     )

@@ -23,6 +23,7 @@ cut link expires the lease exactly like a crashed in-process worker.
 
 from __future__ import annotations
 
+import itertools
 import json
 import socket
 import threading
@@ -47,6 +48,7 @@ from adapt_tpu.config import FaultConfig
 from adapt_tpu.control.registry import WorkerRegistry
 from adapt_tpu.control.worker import TaskResult, WorkerState
 from adapt_tpu.utils.logging import get_logger
+from adapt_tpu.utils.metrics import global_metrics
 
 log = get_logger("remote")
 
@@ -59,6 +61,29 @@ MSG_CONFIG_ERR = 8
 #: round-trip the serve loop itself, so a hung server misses it.
 MSG_PROBE = 9
 MSG_PROBE_ACK = 10
+#: Streamed configure (reference: count-prefixed sequence of per-array
+#: compressed frames, ``src/dispatcher.py:76-89`` / ``src/node.py:
+#: 101-119``): MSG_CONFIG carries the JSON header (model, cuts, stage,
+#: array count, generation in ``request_id``), then one MSG_CONFIG_ARRAY
+#: per weight leaf (``attempt`` = leaf index), then MSG_CONFIG_END. Each
+#: frame takes the send lock independently, so probes and data interleave
+#: with a multi-hundred-MB weights transfer instead of queueing behind it.
+MSG_CONFIG_ARRAY = 11
+MSG_CONFIG_END = 12
+#: Worker-initiated join (reference: the WORKER writes /workers/<ip> into
+#: etcd and the dispatcher discovers it, ``src/node_state.py:17-20``):
+#: a fresh worker dials the dispatcher's WorkerGateway and announces
+#: itself with MSG_HELLO {worker_id}; the gateway wraps the accepted
+#: socket in a RemoteWorkerProxy, registers the lease, and answers
+#: MSG_HELLO_ACK. The pool can now GROW at runtime, not only shrink.
+MSG_HELLO = 13
+MSG_HELLO_ACK = 14
+#: Drop a stage binding (and/or an in-flight configure). Sent by the proxy
+#: when a configure fails or is aborted after CONFIG_END already went out:
+#: without it the server would install and pin the stage weights for a
+#: handshake the dispatcher has already declared dead. ``request_id`` is
+#: the generation to revoke, or 0 to drop whatever is installed.
+MSG_UNCONFIGURE = 15
 
 
 # --------------------------------------------------------------------------
@@ -82,14 +107,15 @@ class RemoteStageServer:
         self.heartbeat_s = heartbeat_s
         self._graph_cache: dict[str, Any] = {}
         self._stages: dict[int, tuple[Any, Any]] = {}  # idx -> (fn, vars)
+        self._stage_gen: dict[int, int] = {}  # idx -> installing generation
         self._codec: codec_lib.Codec = codec_lib.get_codec("none")
         self._hung = False
         self._crashed = False
 
-    def _build_stage(self, cfg: dict, weights: bytes):
-        """Rebuild the named model, slice it, and load the stage weights."""
-        from flax import serialization
-
+    def _build_stage(self, cfg: dict, leaves: list):
+        """Rebuild the named model, slice it, and load the stage weights
+        from the streamed per-array ``leaves`` (reference receiver:
+        ``src/node.py:101-119``, count-prefixed per-array frames)."""
         from adapt_tpu.graph.partition import partition
         from adapt_tpu.models import MODEL_REGISTRY
 
@@ -117,7 +143,13 @@ class RemoteStageServer:
             )
         spec = plan.stages[idx]
         stage_template = {n: template[n] for n in spec.node_names}
-        variables = serialization.from_bytes(stage_template, weights)
+        t_leaves, treedef = jax.tree_util.tree_flatten(stage_template)
+        if len(leaves) != len(t_leaves):
+            raise ValueError(
+                f"stage {idx}: got {len(leaves)} weight arrays, template "
+                f"has {len(t_leaves)}"
+            )
+        variables = jax.tree_util.tree_unflatten(treedef, leaves)
         variables = jax.device_put(variables, self.device)
         jax.block_until_ready(variables)
         fn = jax.jit(plan.stage_apply(spec))
@@ -145,27 +177,100 @@ class RemoteStageServer:
                     return
 
         threading.Thread(target=ping_loop, daemon=True).start()
+        # (stage, generation) -> {"cfg": dict, "arrays": {index: ndarray}}:
+        # a configure in flight, assembled from interleaved frames. Two
+        # concurrent configures for the same stage (the dispatcher recovery
+        # path) stay separate because the generation disambiguates.
+        pending: dict[tuple[int, int], dict] = {}
         try:
             while not self._crashed:
                 msg = recv_msg(conn)
+                if pending:
+                    # Purge abandoned configures on every message: an
+                    # aborted mid-stream configure whose UNCONFIGURE also
+                    # got lost must not retain its buffered weight arrays
+                    # for the life of the connection. Idle-based (not
+                    # supersede-on-same-stage) so neither a LIVE concurrent
+                    # configure of the same stage — the dispatcher recovery
+                    # path — nor a slow-but-streaming transfer is evicted.
+                    now = time.monotonic()
+                    for key in [
+                        k
+                        for k, e in pending.items()
+                        if now - e["ts"] > 300.0
+                    ]:
+                        del pending[key]
                 if msg.msg_type == MSG_CONFIG:
-                    hlen = int.from_bytes(msg.payload[:4], "big")
-                    cfg = json.loads(msg.payload[4 : 4 + hlen].decode())
-                    weights = msg.payload[4 + hlen :]
+                    cfg = json.loads(msg.payload.decode())
+                    pending[(msg.stage_index, msg.request_id)] = {
+                        "cfg": cfg,
+                        "arrays": {},
+                        "ts": time.monotonic(),
+                    }
+                elif msg.msg_type == MSG_CONFIG_ARRAY:
+                    entry = pending.get((msg.stage_index, msg.request_id))
+                    if entry is not None:
+                        entry["arrays"][msg.attempt] = codec_lib.unpack(
+                            msg.payload
+                        )
+                        # Keep-alive: the purge below is idle-based, so a
+                        # legitimately slow (>300 s) streaming transfer is
+                        # never evicted while frames still arrive.
+                        entry["ts"] = time.monotonic()
+                elif msg.msg_type == MSG_CONFIG_END:
+                    key = (msg.stage_index, msg.request_id)
+                    entry = pending.pop(key, None)
                     try:
-                        self._build_stage(cfg, weights)
-                        reply(Message(MSG_ACK, msg.stage_index, 0, 0, b""))
+                        if entry is None:
+                            raise RuntimeError(
+                                f"CONFIG_END for unknown configure {key}"
+                            )
+                        cfg, arrays = entry["cfg"], entry["arrays"]
+                        n = cfg["n_arrays"]
+                        if len(arrays) != n:
+                            raise RuntimeError(
+                                f"stage {msg.stage_index}: received "
+                                f"{len(arrays)}/{n} weight arrays"
+                            )
+                        leaves = [arrays[i] for i in range(n)]
+                        self._build_stage(cfg, leaves)
+                        self._stage_gen[msg.stage_index] = msg.request_id
+                        reply(
+                            Message(
+                                MSG_ACK,
+                                msg.stage_index,
+                                msg.request_id,
+                                0,
+                                b"",
+                            )
+                        )
                     except Exception as e:  # noqa: BLE001
                         log.error("remote configure failed: %s", e)
                         reply(
                             Message(
                                 MSG_CONFIG_ERR,
                                 msg.stage_index,
-                                0,
+                                msg.request_id,
                                 0,
                                 str(e).encode(),
                             )
                         )
+                elif msg.msg_type == MSG_UNCONFIGURE:
+                    gen = msg.request_id
+                    pending.pop((msg.stage_index, gen), None)
+                    # Revoke the install only if it came from the revoked
+                    # generation (or unconditionally for gen 0) — a newer
+                    # configure's binding must survive an old revoke.
+                    if gen == 0 or self._stage_gen.get(msg.stage_index) == gen:
+                        self._stages.pop(msg.stage_index, None)
+                        self._stage_gen.pop(msg.stage_index, None)
+                        log.info(
+                            "stage %d unconfigured (gen %d)",
+                            msg.stage_index,
+                            gen,
+                        )
+                elif msg.msg_type == MSG_HELLO_ACK:
+                    continue  # join handshake answer; nothing to do
                 elif msg.msg_type == MSG_DATA:
                     if self._hung:
                         continue  # swallow; watchdog must recover
@@ -205,7 +310,9 @@ class RemoteStageServer:
             x = codec_lib.unpack(msg.payload)
             y = fn(variables, jax.device_put(x, self.device))
             y.block_until_ready()
-            out = codec_lib.pack(self._codec, np.asarray(y))
+            # Device array handed to the codec directly: int8dev quantizes
+            # on-chip before the host fetch; host codecs coerce themselves.
+            out = codec_lib.pack(self._codec, y)
             reply(
                 Message(
                     MSG_RESULT, msg.stage_index, msg.request_id, msg.attempt, out
@@ -238,6 +345,38 @@ class RemoteStageServer:
             self._handle(conn)
         srv.close()
 
+    def connect_and_serve(
+        self, address: tuple[str, int], worker_id: str, retries: int = 20
+    ) -> None:
+        """Worker-initiated join: dial the dispatcher's WorkerGateway,
+        announce ourselves, then serve the connection. The TPU-native
+        re-expression of the reference worker self-registering in etcd
+        (``/root/reference/src/node_state.py:17-20``) — here the dial +
+        MSG_HELLO *is* the registration write, and the gateway-side lease
+        renewal rides the same connection's pings."""
+        last: Exception | None = None
+        for _ in range(retries):
+            try:
+                conn = socket.create_connection(address, timeout=5.0)
+                break
+            except OSError as e:
+                last = e
+                time.sleep(0.25)
+        else:
+            raise ConnectionError(
+                f"cannot reach gateway at {address}: {last}"
+            )
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # create_connection's 5 s dial timeout must NOT linger on the
+        # serving socket: a timed-out mid-frame result send would desync
+        # the stream and a slow ping send would kill the heartbeat thread.
+        # Serving uses blocking sends, like the dial-in accept path.
+        conn.settimeout(None)
+        hello = json.dumps({"worker_id": worker_id}).encode()
+        send_msg(conn, Message(MSG_HELLO, 0, 0, 0, hello))
+        log.info("joined gateway %s:%d as %s", *address, worker_id)
+        self._handle(conn)
+
 
 # --------------------------------------------------------------------------
 # Dispatcher side
@@ -255,8 +394,18 @@ class RemoteWorkerProxy:
         result_queue,
         model_config: dict,
         codec_name: str = "none",
+        weights_codec: str = "lz",
         fault: FaultConfig | None = None,
+        sock: socket.socket | None = None,
+        blob_cache: dict | None = None,
     ):
+        """``sock`` — an already-connected socket (gateway path: the worker
+        dialed us); when None, :meth:`start` dials ``address``.
+
+        ``blob_cache`` — optional dict shared across proxies (the gateway
+        passes one): packed stage-weight frames are deterministic for a
+        given (stage, codec), so N joining workers — or one recovery storm
+        re-configuring the same stage — pay the compression pass once."""
         self.worker_id = worker_id
         self.address = address
         self._registry = registry
@@ -265,11 +414,19 @@ class RemoteWorkerProxy:
         self._model_config = model_config
         self._codec = codec_lib.get_codec(codec_name)
         self._codec_name = codec_name
-        self._sock: socket.socket | None = None
+        self._wcodec = codec_lib.get_codec(weights_codec)
+        self._sock: socket.socket | None = sock
         self._send_lock = threading.Lock()
-        self._configured: set[int] = set()
-        self._config_acks: dict[int, threading.Event] = {}
-        self._config_errors: dict[int, str] = {}
+        self._configured: dict[int, int] = {}  # stage -> newest gen installed
+        # Config handshake state keyed by (stage_index, generation): two
+        # concurrent configures for the same stage (reachable from two
+        # forward threads on the recovery path) get independent events
+        # instead of clobbering each other's.
+        self._config_gen = itertools.count(1)
+        self._blob_cache = blob_cache
+        self._ack_lock = threading.Lock()
+        self._config_acks: dict[tuple[int, int], threading.Event] = {}
+        self._config_errors: dict[tuple[int, int], str] = {}
         self._inflight_count = 0
         self._count_lock = threading.Lock()
         self._stop = threading.Event()
@@ -278,23 +435,32 @@ class RemoteWorkerProxy:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "RemoteWorkerProxy":
-        deadline = time.monotonic() + self._fault.startup_wait_s
-        last: Exception | None = None
-        while time.monotonic() < deadline:
-            try:
-                self._sock = socket.create_connection(self.address, timeout=5.0)
-                self._sock.setsockopt(
-                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
-                )
-                break
-            except OSError as e:
-                last = e
-                time.sleep(0.1)
         if self._sock is None:
-            raise ConnectionError(
-                f"cannot reach remote worker at {self.address}: {last}"
-            )
-        self._registry.register(
+            deadline = time.monotonic() + self._fault.startup_wait_s
+            last: Exception | None = None
+            while time.monotonic() < deadline:
+                try:
+                    self._sock = socket.create_connection(
+                        self.address, timeout=5.0
+                    )
+                    self._sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                    break
+                except OSError as e:
+                    last = e
+                    time.sleep(0.1)
+            if self._sock is None:
+                raise ConnectionError(
+                    f"cannot reach remote worker at {self.address}: {last}"
+                )
+        # Socket timeout bounds blocked *sends* (wedged peer, full TCP
+        # buffers); the reader side retries through timeouts (framing).
+        self._sock.settimeout(self._fault.send_timeout_s)
+        # Keep the ownership token: if THIS connection dies after a
+        # replacement worker re-registered the same id, our deregister
+        # must not evict the replacement's lease.
+        self._lease_token = self._registry.register(
             self.worker_id,
             meta={"address": f"{self.address[0]}:{self.address[1]}"},
             ttl_s=self._fault.lease_ttl_s,
@@ -314,7 +480,56 @@ class RemoteWorkerProxy:
                 pass
         if self._reader is not None:
             self._reader.join(timeout=2.0)
-        self._registry.deregister(self.worker_id)
+        self._registry.deregister(
+            self.worker_id, token=getattr(self, "_lease_token", None)
+        )
+
+    def _mark_dead(self, why: str) -> None:
+        """Tear the link down after a send timeout/failure: a partial send
+        leaves the stream state unknowable, so the only safe move is to
+        drop the connection and let membership re-dispatch our in-flight
+        work (immediately, via deregister — no need to wait out the lease)."""
+        if self._stop.is_set():
+            return
+        log.warning("remote %s link dropped: %s", self.worker_id, why)
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._registry.deregister(
+            self.worker_id, token=getattr(self, "_lease_token", None)
+        )
+
+    def _send(self, msg: Message, lock_timeout: float | None = None) -> None:
+        """Bounded send: both the wait for the channel lock and the socket
+        write itself are time-limited (reference analog: non-blocking
+        sends with select backpressure, ``src/node_state.py:39-89``). A
+        lock timeout raises but keeps the link (the channel was merely
+        busy); a *socket* timeout kills the link (bytes may be half-sent)."""
+        if self._stop.is_set():
+            raise ConnectionError(
+                f"remote worker {self.worker_id} link is down"
+            )
+        timeout = (
+            self._fault.send_timeout_s if lock_timeout is None else lock_timeout
+        )
+        if not self._send_lock.acquire(timeout=timeout):
+            raise TimeoutError(
+                f"{self.worker_id} send channel busy for {timeout}s"
+            )
+        try:
+            send_msg(self._sock, msg)
+        except TimeoutError:
+            self._mark_dead("send timed out (peer not draining)")
+            raise ConnectionError(
+                f"send to {self.worker_id} timed out; link dropped"
+            ) from None
+        except OSError as e:
+            self._mark_dead(f"send failed: {e}")
+            raise
+        finally:
+            self._send_lock.release()
 
     # -- StageWorker interface ----------------------------------------------
 
@@ -335,80 +550,159 @@ class RemoteWorkerProxy:
     def is_configured(self, stage_index: int) -> bool:
         return stage_index in self._configured
 
-    def configure(self, stage_index: int, fn, host_variables, spec=None) -> None:
-        """Ship (model name, cuts, stage index, weights) and wait for ACK.
-        ``fn`` is ignored — the remote compiles its own stage program."""
-        from flax import serialization
-
+    def configure(
+        self, stage_index: int, fn, host_variables, spec=None, abort=None
+    ) -> int:
+        """Ship (model name, cuts, stage index) + the stage weights as a
+        count-prefixed stream of per-array compressed frames (reference:
+        ``src/dispatcher.py:76-89``), then wait for the generation's ACK.
+        ``fn`` is ignored — the remote compiles its own stage program.
+        Each array frame takes the send lock independently, so data and
+        probe traffic interleave with a large weights transfer instead of
+        queueing behind one monolithic send."""
         del fn, spec
+        if self._stop.is_set():
+            raise ConnectionError(
+                f"remote worker {self.worker_id} link is down"
+            )
+        gen = next(self._config_gen)
+        key = (stage_index, gen)
+        cache_key = (stage_index, self._wcodec.name)
+        blobs = (
+            self._blob_cache.get(cache_key)
+            if self._blob_cache is not None
+            else None
+        )
+        if blobs is None:
+            leaves = jax.tree_util.tree_leaves(host_variables)
+            blobs = [
+                codec_lib.pack(self._wcodec, np.asarray(leaf))
+                for leaf in leaves
+            ]
+            if self._blob_cache is not None:
+                self._blob_cache[cache_key] = blobs
         header = json.dumps(
             {
                 **self._model_config,
                 "stage_index": stage_index,
                 "codec": self._codec_name,
+                "n_arrays": len(blobs),
             }
         ).encode()
-        weights = serialization.to_bytes(host_variables)
-        payload = len(header).to_bytes(4, "big") + header + weights
         ack = threading.Event()
-        self._config_acks[stage_index] = ack
-        with self._send_lock:
-            send_msg(
-                self._sock, Message(MSG_CONFIG, stage_index, 0, 0, payload)
+        with self._ack_lock:
+            self._config_acks[key] = ack
+        end_sent = False
+        try:
+            self._send(Message(MSG_CONFIG, stage_index, gen, 0, header))
+            for i, blob in enumerate(blobs):
+                if abort is not None and abort():
+                    raise RuntimeError(
+                        f"configure of stage {stage_index} aborted "
+                        f"mid-stream (caller timed out)"
+                    )
+                self._send(
+                    Message(MSG_CONFIG_ARRAY, stage_index, gen, i, blob)
+                )
+            end_sent = True
+            self._send(Message(MSG_CONFIG_END, stage_index, gen, 0, b""))
+            if not ack.wait(self._fault.configure_timeout_s):
+                raise TimeoutError(
+                    f"no config ACK for stage {stage_index} (gen {gen}) "
+                    f"from {self.worker_id}"
+                )
+            with self._ack_lock:
+                err = self._config_errors.pop(key, None)
+            if err is not None:
+                raise RuntimeError(f"remote configure failed: {err}")
+            if abort is not None and abort():
+                raise RuntimeError(
+                    f"configure of stage {stage_index} aborted "
+                    f"(caller timed out)"
+                )
+            self._configured[stage_index] = max(
+                self._configured.get(stage_index, 0), gen
             )
-        if not ack.wait(self._fault.configure_timeout_s):
-            raise TimeoutError(
-                f"no config ACK for stage {stage_index} from "
-                f"{self.worker_id}"
+            return gen
+        except BaseException:
+            # CONFIG_END already went out (or an abort fired late): the
+            # server may install — or have installed — the stage for a
+            # handshake we just declared failed. Revoke this generation so
+            # the worker doesn't pin abandoned weights; the revoke is
+            # gen-scoped, so a racing newer configure's binding survives.
+            if end_sent:
+                try:
+                    self._send(
+                        Message(MSG_UNCONFIGURE, stage_index, gen, 0, b"")
+                    )
+                except Exception:  # noqa: BLE001 — link may be down
+                    pass
+            raise
+        finally:
+            with self._ack_lock:
+                self._config_acks.pop(key, None)
+                self._config_errors.pop(key, None)
+
+    def unconfigure(
+        self, stage_index: int, generation: int | None = None
+    ) -> None:
+        """Drop the stage binding on the remote (and locally): interface
+        parity with ``StageWorker.unconfigure``. With ``generation``, the
+        revoke is scoped to that configure (gen 0 = unconditional) so a
+        newer configure's binding survives an old undo."""
+        if generation is None:
+            self._configured.pop(stage_index, None)
+        elif self._configured.get(stage_index) == generation:
+            self._configured.pop(stage_index, None)
+        try:
+            self._send(
+                Message(
+                    MSG_UNCONFIGURE, stage_index, generation or 0, 0, b""
+                )
             )
-        err = self._config_errors.pop(stage_index, None)
-        if err is not None:
-            raise RuntimeError(f"remote configure failed: {err}")
-        self._configured.add(stage_index)
+        except Exception:  # noqa: BLE001 — best effort; link may be down
+            pass
 
     def submit(self, task) -> None:
         if task.stage_index < 0:
             # Canary probe (control.dispatcher watchdog): no payload, no
             # in-flight accounting — the dispatcher tracks it in _probes.
-            # Bounded lock wait: the watchdog thread calls this, and it
-            # must never block behind a configure() holding _send_lock
-            # across a multi-hundred-MB weights send to a wedged peer.
-            if not self._send_lock.acquire(timeout=1.0):
-                raise TimeoutError(
-                    f"{self.worker_id} send channel busy; probe dropped"
-                )
-            try:
-                send_msg(
-                    self._sock,
-                    Message(
-                        MSG_PROBE,
-                        task.stage_index,
-                        task.request_id,
-                        task.attempt,
-                        b"",
-                    ),
-                )
-            finally:
-                self._send_lock.release()
+            # Extra-short lock wait: the watchdog thread calls this and a
+            # dropped probe is recoverable (it just re-probes later).
+            self._send(
+                Message(
+                    MSG_PROBE,
+                    task.stage_index,
+                    task.request_id,
+                    task.attempt,
+                    b"",
+                ),
+                lock_timeout=1.0,
+            )
             return
-        payload = codec_lib.pack(self._codec, np.asarray(task.payload))
+        # Pass the payload through un-coerced: device-side codecs
+        # (int8dev) quantize on-chip BEFORE the host fetch; host codecs
+        # call np.ascontiguousarray themselves.
+        payload = codec_lib.pack(self._codec, task.payload)
         with self._count_lock:
             self._inflight_count += 1
-        with self._send_lock:
-            send_msg(
-                self._sock,
+        try:
+            self._send(
                 Message(
                     MSG_DATA,
                     task.stage_index,
                     task.request_id,
                     task.attempt,
                     payload,
-                ),
+                )
             )
+        except Exception:
+            with self._count_lock:
+                self._inflight_count = max(0, self._inflight_count - 1)
+            raise
 
     def kill(self, mode: str = "crash") -> None:
-        with self._send_lock:
-            send_msg(self._sock, Message(MSG_KILL, 0, 0, 0, mode.encode()))
+        self._send(Message(MSG_KILL, 0, 0, 0, mode.encode()))
 
     # -- internals -----------------------------------------------------------
 
@@ -432,12 +726,17 @@ class RemoteWorkerProxy:
                     )
                 )
             elif msg.msg_type == MSG_ACK:
-                ev = self._config_acks.get(msg.stage_index)
+                with self._ack_lock:
+                    ev = self._config_acks.get(
+                        (msg.stage_index, msg.request_id)
+                    )
                 if ev is not None:
                     ev.set()
             elif msg.msg_type == MSG_CONFIG_ERR:
-                self._config_errors[msg.stage_index] = msg.payload.decode()
-                ev = self._config_acks.get(msg.stage_index)
+                key = (msg.stage_index, msg.request_id)
+                with self._ack_lock:
+                    self._config_errors[key] = msg.payload.decode()
+                    ev = self._config_acks.get(key)
                 if ev is not None:
                     ev.set()
             elif msg.msg_type in (MSG_RESULT, MSG_ERROR):
@@ -463,26 +762,184 @@ class RemoteWorkerProxy:
                             error=msg.payload.decode(),
                         )
                     )
-        # Socket gone: stop renewing the lease; the reaper will evict us.
+        # Socket gone: mark the link dead so the scheduler stops picking
+        # us and membership re-dispatches in-flight work immediately
+        # (stopping lease renewal alone would add a full TTL of latency).
+        self._mark_dead("connection closed")
+        # Unblock any configure() still waiting on an ACK that can never
+        # arrive now.
+        with self._ack_lock:
+            for key, ev in self._config_acks.items():
+                self._config_errors.setdefault(key, "link down")
+                ev.set()
+
+
+class WorkerGateway:
+    """Dispatcher-side listener for worker-initiated joins.
+
+    The reference's pool can grow because the *worker* registers itself in
+    etcd and the dispatcher discovers it (``/root/reference/src/
+    node_state.py:17-20``, read at ``src/dispatcher.py:285-289``). Here a
+    fresh worker dials this gateway (``python -m adapt_tpu.comm.remote
+    --connect host:port``), announces MSG_HELLO, and the gateway wraps the
+    accepted socket in a :class:`RemoteWorkerProxy`, registers its lease,
+    and attaches it to the dispatcher — which fires the registry ``join``
+    watch and prewarms the newcomer's executables
+    (``control/dispatcher.py`` ``_on_membership``). From that point the
+    joined worker is indistinguishable from a dial-out proxy: late
+    binding, probes, quarantine, and re-dispatch all apply.
+
+    Codec routing: the activation and weights codecs come from the
+    dispatcher's ``ServeConfig.codec`` — the one knob configures every
+    worker that joins."""
+
+    def __init__(
+        self,
+        dispatcher,
+        model_config: dict,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self._dispatcher = dispatcher
+        self._model_config = model_config
+        codec_cfg = dispatcher.config.codec
+        self._codec_name = codec_cfg.name
+        self._weights_codec = codec_cfg.weights
+        self._fault = dispatcher.config.fault
+        self._host = host
+        self._port = port
+        self._srv: socket.socket | None = None
+        self._stop = threading.Event()
+        self._accept_thread: threading.Thread | None = None
+        self._proxies: list[RemoteWorkerProxy] = []
+        self._proxies_lock = threading.Lock()
+        # Shared across all joined workers: the packed weight frames for a
+        # stage are identical for every joiner, so compress once.
+        self._blob_cache: dict = {}
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self) -> "WorkerGateway":
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((self._host, self._port))
+        self._srv.listen(16)
+        self._port = self._srv.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="gateway-accept", daemon=True
+        )
+        self._accept_thread.start()
+        log.info("worker gateway listening on %s:%d", self._host, self._port)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+        with self._proxies_lock:
+            proxies = list(self._proxies)
+        for p in proxies:
+            p.stop()
+
+    def _accept_loop(self) -> None:
+        self._srv.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # Hard deadline on HELLO: this loop is serial, so a silent
+                # dialer must not block every other join.
+                conn.settimeout(10.0)
+                msg = recv_msg(conn, retry_on_timeout=False)
+                if msg.msg_type != MSG_HELLO:
+                    raise ValueError(
+                        f"expected HELLO, got msg type {msg.msg_type}"
+                    )
+                info = json.loads(msg.payload.decode())
+                worker_id = info["worker_id"]
+                proxy = RemoteWorkerProxy(
+                    worker_id,
+                    addr,
+                    self._dispatcher.registry,
+                    self._dispatcher.result_queue,
+                    model_config=self._model_config,
+                    codec_name=self._codec_name,
+                    weights_codec=self._weights_codec,
+                    fault=self._fault,
+                    sock=conn,
+                    blob_cache=self._blob_cache,
+                )
+                proxy.start()  # registers lease -> registry 'join' fires
+                self._dispatcher.attach_worker(proxy)
+                proxy._send(Message(MSG_HELLO_ACK, 0, 0, 0, b""))
+                with self._proxies_lock:
+                    # Sweep proxies whose links died (worker churn): the
+                    # gateway must not accumulate a dead proxy per join
+                    # for its lifetime.
+                    self._proxies = [
+                        p for p in self._proxies if not p._stop.is_set()
+                    ]
+                    self._proxies.append(proxy)
+                log.info("worker %s joined via gateway (%s)", worker_id, addr)
+                global_metrics().inc("gateway.joins")
+            except Exception as e:  # noqa: BLE001 — a bad joiner can't kill the loop
+                log.warning("gateway join from %s failed: %s", addr, e)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
 
 def main() -> None:
-    """CLI entry: ``python -m adapt_tpu.comm.remote --port 7001``
-    (the reference's ``python -m src.node``, README.md:44)."""
+    """CLI entry (the reference's ``python -m src.node``, README.md:44):
+
+    - ``python -m adapt_tpu.comm.remote --port 7001`` — listen and wait
+      for a dispatcher to dial in (dial-out proxy path).
+    - ``python -m adapt_tpu.comm.remote --connect host:port`` — join a
+      RUNNING pipeline through its WorkerGateway (worker-initiated
+      registration, ``src/node_state.py:17-20``)."""
     import argparse
+    import os
 
     p = argparse.ArgumentParser()
-    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--port", type=int, default=None)
+    p.add_argument(
+        "--connect",
+        default=None,
+        metavar="HOST:PORT",
+        help="dial a dispatcher WorkerGateway and join its pool",
+    )
+    p.add_argument("--worker-id", default=None)
     p.add_argument("--device-index", type=int, default=0)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--heartbeat", type=float, default=0.5)
     args = p.parse_args()
-    RemoteStageServer(
-        args.port,
+    if (args.port is None) == (args.connect is None):
+        p.error("exactly one of --port / --connect is required")
+    server = RemoteStageServer(
+        args.port or 0,
         device_index=args.device_index,
         heartbeat_s=args.heartbeat,
         host=args.host,
-    ).serve_forever()
+    )
+    if args.connect is not None:
+        host, _, port = args.connect.rpartition(":")
+        worker_id = args.worker_id or f"remote-{os.getpid()}"
+        server.connect_and_serve((host, int(port)), worker_id)
+    else:
+        server.serve_forever()
 
 
 if __name__ == "__main__":

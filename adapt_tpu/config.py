@@ -46,16 +46,31 @@ class FaultConfig:
     # Worker-configuration handshake timeout; reference: connect 5 s /
     # ACK 60 s (dispatcher.py:226,250-260).
     configure_timeout_s: float = 60.0
+    # Bound on any single cross-host socket send AND on waiting for the
+    # send channel lock: a hung peer with a full TCP buffer must never
+    # wedge a forward-pool or watchdog thread (the reference's transport
+    # is non-blocking with select backpressure for the same reason,
+    # node_state.py:39-89). A send that exceeds this marks the connection
+    # dead (stream state is unknowable after a partial send).
+    send_timeout_s: float = 10.0
 
 
 @dataclasses.dataclass(frozen=True)
 class CodecConfig:
-    """Activation codec at host/DCN boundaries (reference compresses every
-    hop with zfp+lz4, dispatcher.py:92-98; on TPU, ICI hops need none)."""
+    """Activation/weights codecs at host/DCN boundaries (reference
+    compresses every hop with zfp+lz4, dispatcher.py:92-98; on TPU, ICI
+    hops need none). Consumed by ``comm.remote.WorkerGateway`` (every
+    proxy it spawns for an inbound worker uses these codecs) and by
+    ``LocalPipeline.from_config`` hop transforms — in-process device-to-
+    device hops ignore it by design."""
 
-    name: str = "none"  # none | bf16 | int8 | zfp
+    name: str = "none"  # none | bf16 | int8 | int8dev | zfp | lz
     # zfp-style fixed tolerance (absolute) when name == "zfp".
     tolerance: float = 1e-3
+    # Codec for stage *weights* on cross-host configure. Lossless by
+    # default (the largest payload in the system; reference compresses
+    # every weight array, src/dispatcher.py:76-89).
+    weights: str = "lz"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -315,10 +315,14 @@ class Dispatcher:
         if self._shutdown.is_set():
             return
         with self._workers_lock:
+            # Remote proxies carry no local device (their server compiles
+            # its own stage programs); prewarm only covers in-process
+            # workers' devices.
             devices = {
                 w.device
                 for w in self._workers.values()
                 if w.state is not WorkerState.DEAD
+                and getattr(w, "device", None) is not None
             }
         with self._prewarm_lock:
             examples = dict(self._stage_examples)
@@ -473,18 +477,30 @@ class Dispatcher:
         self, worker: StageWorker, stage_index: int
     ) -> None:
         """Bounded config handshake (reference ACK timeout,
-        ``src/dispatcher.py:246-260``)."""
+        ``src/dispatcher.py:246-260``). On timeout the worker thread is
+        abandoned but *cancelled*: the ``abort`` token is checked by the
+        worker immediately before installing the binding, so a timed-out
+        configure can never install state (or pin weight HBM) after this
+        dispatcher has declared it failed and moved on."""
         done = threading.Event()
+        abandoned = threading.Event()
         errors: list[Exception] = []
 
         def _cfg():
             try:
-                worker.configure(
+                gen = worker.configure(
                     stage_index,
                     self._stage_fns[stage_index],
                     self._stage_host_vars[stage_index],
                     spec=self.plan.stages[stage_index],
+                    abort=abandoned.is_set,
                 )
+                if abandoned.is_set():
+                    # Install won the race with the timeout decision by a
+                    # hair: undo it so no binding (or pinned weights)
+                    # survives a configure the dispatcher reported failed.
+                    # Gen-scoped: a newer configure's binding survives.
+                    worker.unconfigure(stage_index, gen)
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
             finally:
@@ -493,6 +509,7 @@ class Dispatcher:
         t = threading.Thread(target=_cfg, daemon=True)
         t.start()
         if not done.wait(self.config.fault.configure_timeout_s):
+            abandoned.set()
             raise RequestFailed(
                 f"configure of stage {stage_index} on {worker.worker_id} "
                 f"timed out after {self.config.fault.configure_timeout_s}s"

@@ -29,6 +29,13 @@ class WorkerRegistry:
         self._lock = threading.Lock()
         self._leases: dict[str, float] = {}  # worker_id -> expiry time
         self._meta: dict[str, dict] = {}
+        # Lease ownership: each register() bumps the id's token. A holder
+        # that passes its token to deregister() can only revoke its OWN
+        # lease — a stale connection dying late cannot evict the
+        # replacement that re-registered under the same worker id (etcd
+        # lease-id semantics: the key outlives any one lease holder).
+        self._tokens: dict[str, int] = {}
+        self._token_counter = 0
         self._watchers: list[Callable[[str, str], None]] = []
         self._default_ttl = default_ttl_s
         self._reap_period = reap_period_s
@@ -55,18 +62,27 @@ class WorkerRegistry:
 
     def register(
         self, worker_id: str, meta: dict | None = None, ttl_s: float | None = None
-    ) -> None:
+    ) -> int:
+        """Create/renew a lease; returns an ownership token for
+        :meth:`deregister` (latest registration wins the id)."""
         with self._lock:
             fresh = worker_id not in self._leases
             self._leases[worker_id] = time.monotonic() + (
                 ttl_s or self._default_ttl
             )
             self._meta[worker_id] = dict(meta or {})
+            self._token_counter += 1
+            token = self._token_counter
+            self._tokens[worker_id] = token
             watchers = list(self._watchers) if fresh else []
         for cb in watchers:
-            cb("join", worker_id)
+            try:
+                cb("join", worker_id)
+            except Exception:  # noqa: BLE001 — a watcher bug must not
+                log.exception("join watcher failed")  # break membership
         if fresh:
             log.info("worker joined: %s", worker_id)
+        return token
 
     def heartbeat(self, worker_id: str, ttl_s: float | None = None) -> bool:
         """Renew a lease; returns False if the lease already expired (the
@@ -79,8 +95,13 @@ class WorkerRegistry:
             )
             return True
 
-    def deregister(self, worker_id: str) -> None:
-        self._expire([worker_id], reason="deregister")
+    def deregister(self, worker_id: str, token: int | None = None) -> None:
+        """Remove a lease. With ``token``, only if the caller still owns
+        the id — a late deregister from a superseded holder is a no-op.
+        (The ownership check happens under the same lock that deletes, so
+        a replacement registering between check and delete cannot be
+        evicted by the stale holder.)"""
+        self._expire([worker_id], reason="deregister", token=token)
 
     # -- dispatcher API (reference: _get_available_workers / _worker_monitor)
 
@@ -110,19 +131,27 @@ class WorkerRegistry:
 
     # -- internals ----------------------------------------------------------
 
-    def _expire(self, worker_ids: list[str], reason: str) -> None:
+    def _expire(
+        self, worker_ids: list[str], reason: str, token: int | None = None
+    ) -> None:
         fired = []
         with self._lock:
             for w in worker_ids:
+                if token is not None and self._tokens.get(w) != token:
+                    continue  # superseded holder: the id is not ours to kill
                 if w in self._leases:
                     del self._leases[w]
                     self._meta.pop(w, None)
+                    self._tokens.pop(w, None)
                     fired.append(w)
             watchers = list(self._watchers)
         for w in fired:
             log.info("worker left (%s): %s", reason, w)
             for cb in watchers:
-                cb("leave", w)
+                try:
+                    cb("leave", w)
+                except Exception:  # noqa: BLE001
+                    log.exception("leave watcher failed")
 
     def _reap_loop(self) -> None:
         while not self._stop.wait(self._reap_period):

@@ -20,6 +20,7 @@ Kill modes for fault injection (SURVEY.md §5 'chaos hook'):
 from __future__ import annotations
 
 import enum
+import itertools
 import queue
 import threading
 from dataclasses import dataclass, field
@@ -78,6 +79,7 @@ class _StageBinding:
     variables: Any  # device-resident
     device: jax.Device
     spec: Any = field(default=None)
+    generation: int = 0  # which configure installed this binding
 
 
 class StageWorker:
@@ -98,6 +100,7 @@ class StageWorker:
         self._fault = fault or FaultConfig()
         self._inbox: queue.Queue[Task | None] = queue.Queue()
         self._bindings: dict[int, _StageBinding] = {}
+        self._bind_gen = itertools.count(1)
         self._bind_lock = threading.Lock()
         self._state = WorkerState.IDLE
         self._state_lock = threading.Lock()
@@ -165,20 +168,54 @@ class StageWorker:
         with self._bind_lock:
             return stage_index in self._bindings
 
-    def configure(self, stage_index: int, fn, host_variables, spec=None) -> None:
+    def configure(
+        self, stage_index: int, fn, host_variables, spec=None, abort=None
+    ) -> int:
         """Install a stage on this worker's device; returns when weights are
         resident (the reference's JSON+weights+ACK handshake,
         ``src/dispatcher.py:223-264`` / ``src/node.py:65-98``, collapsed to
-        a device_put + blocking ready wait)."""
+        a device_put + blocking ready wait).
+
+        ``abort`` is an optional zero-arg callable checked before the slow
+        weight transfer and again immediately before installing the
+        binding: a dispatcher that timed out this handshake sets it, so the
+        abandoned configure thread cannot install state (and pin HBM) after
+        the dispatcher moved on.
+
+        Returns a generation handle for :meth:`unconfigure` — a revoke is
+        scoped to the configure that earned it, so undoing an abandoned
+        handshake can never drop a newer configure's binding."""
         if self._crashed.is_set():
             raise RuntimeError(f"worker {self.worker_id} is dead")
+        if abort is not None and abort():
+            raise RuntimeError("configure aborted before weight transfer")
         variables = jax.device_put(host_variables, self.device)
         jax.block_until_ready(variables)  # the ACK
+        generation = next(self._bind_gen)
         with self._bind_lock:
+            if abort is not None and abort():
+                raise RuntimeError("configure aborted (caller timed out)")
             self._bindings[stage_index] = _StageBinding(
-                fn=fn, variables=variables, device=self.device, spec=spec
+                fn=fn,
+                variables=variables,
+                device=self.device,
+                spec=spec,
+                generation=generation,
             )
         global_metrics().inc("worker.configured")
+        return generation
+
+    def unconfigure(self, stage_index: int, generation: int | None = None) -> None:
+        """Drop a stage binding (releases the device weight references).
+        With ``generation``, only if that configure's binding is still the
+        installed one."""
+        with self._bind_lock:
+            binding = self._bindings.get(stage_index)
+            if binding is None:
+                return
+            if generation is not None and binding.generation != generation:
+                return
+            del self._bindings[stage_index]
 
     def submit(self, task: Task) -> None:
         self._inbox.put(task)

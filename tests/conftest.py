@@ -49,3 +49,23 @@ def devices():
 @pytest.fixture
 def rng():
     return jax.random.PRNGKey(0)
+
+
+def spawn_worker_proc(*cli_args: str) -> "subprocess.Popen":
+    """Launch ``python -m adapt_tpu.comm.remote`` as a hermetic CPU child
+    (shared by the comm and stress tests — one place owns the env recipe:
+    drop any interpreter-startup PYTHONPATH hook, force the CPU backend,
+    put the repo on the path)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(os.path.dirname(__file__)))
+    return subprocess.Popen(
+        [sys.executable, "-m", "adapt_tpu.comm.remote", *cli_args],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )

@@ -5,8 +5,6 @@ localhost (its own test affordance, SURVEY.md §4)."""
 
 import os
 import socket
-import subprocess
-import sys
 import threading
 import time
 
@@ -18,6 +16,7 @@ import pytest
 from adapt_tpu.comm import codec as codec_lib
 from adapt_tpu.comm import native
 from adapt_tpu.comm.framing import MSG_DATA, Message, recv_msg, send_msg
+from conftest import spawn_worker_proc
 
 
 # -- native codec -----------------------------------------------------------
@@ -57,7 +56,17 @@ def test_native_large_random_and_structured(size):
 # -- tensor codecs ----------------------------------------------------------
 
 
-@pytest.mark.parametrize("name,rtol", [("none", 0), ("bf16", 1e-2), ("int8", 2e-2), ("zfp", 1e-2)])
+@pytest.mark.parametrize(
+    "name,rtol",
+    [
+        ("none", 0),
+        ("bf16", 1e-2),
+        ("int8", 2e-2),
+        ("zfp", 1e-2),
+        ("lz", 0),
+        ("int8dev", 2e-2),
+    ],
+)
 def test_codec_roundtrip(name, rtol):
     rng = np.random.default_rng(1)
     x = rng.standard_normal((4, 32, 32, 8)).astype(np.float32)
@@ -65,10 +74,42 @@ def test_codec_roundtrip(name, rtol):
     blob, meta = codec.encode(x)
     y = codec.decode(blob, meta)
     assert y.shape == x.shape and y.dtype == x.dtype
-    if name == "none":
+    if name in ("none", "lz"):
         np.testing.assert_array_equal(x, y)
     else:
         assert np.max(np.abs(x - y)) < rtol * max(1.0, np.max(np.abs(x)))
+
+
+def test_lz_codec_lossless_any_dtype():
+    """The weights-path codec must be bit-exact for every dtype a model
+    carries (f32, bf16 params, int32 step counters in opt state)."""
+    import ml_dtypes
+
+    rng = np.random.default_rng(5)
+    for arr in (
+        rng.standard_normal((16, 16)).astype(np.float32),
+        rng.standard_normal((7, 3)).astype(ml_dtypes.bfloat16),
+        rng.integers(-100, 100, size=(12,)).astype(np.int32),
+    ):
+        codec = codec_lib.get_codec("lz")
+        blob, meta = codec.encode(arr)
+        y = codec.decode(blob, meta)
+        assert y.dtype == arr.dtype
+        np.testing.assert_array_equal(np.asarray(arr), np.asarray(y))
+
+
+def test_int8dev_codec_matches_host_oracle():
+    """The on-device (Pallas) codec must agree with the pure-jnp blockwise
+    quantization oracle it re-expresses."""
+    from adapt_tpu.ops.quantize import dequantize_reference, quantize_reference
+
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((3, 50, 17)).astype(np.float32) * 4.0
+    codec = codec_lib.get_codec("int8dev")
+    blob, meta = codec.encode(jnp.asarray(x))
+    y = codec.decode(blob, meta)
+    oracle = np.asarray(dequantize_reference(quantize_reference(jnp.asarray(x))))
+    np.testing.assert_allclose(y, oracle, rtol=0, atol=1e-6)
 
 
 def test_zfp_tolerance_honored():
@@ -147,18 +188,7 @@ def test_framing_negative_ids_roundtrip():
 def remote_worker_proc():
     """A real worker process serving stages over TCP (CPU backend)."""
     port = 17591
-    env = dict(os.environ)
-    env.pop("PYTHONPATH", None)  # skip the axon hook in the child
-    env["JAX_PLATFORMS"] = "cpu"
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "adapt_tpu.comm.remote", "--port", str(port),
-         "--heartbeat", "0.1"],
-        env=env,
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-    )
+    proc = spawn_worker_proc("--port", str(port), "--heartbeat", "0.1")
     yield "127.0.0.1", port
     proc.terminate()
     proc.wait(timeout=10)
@@ -244,18 +274,7 @@ def test_remote_probe_roundtrip_and_hang_swallow():
     from adapt_tpu.control.worker import PING_STAGE, Task
 
     port = 17593
-    env = dict(os.environ)
-    env.pop("PYTHONPATH", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "adapt_tpu.comm.remote", "--port", str(port),
-         "--heartbeat", "0.1"],
-        env=env,
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-    )
+    proc = spawn_worker_proc("--port", str(port), "--heartbeat", "0.1")
     registry = WorkerRegistry(default_ttl_s=2.0).start()
     results: "queue_mod.Queue" = queue_mod.Queue()
     proxy = RemoteWorkerProxy(
@@ -291,3 +310,231 @@ def test_remote_probe_roundtrip_and_hang_swallow():
         registry.stop()
         proc.terminate()
         proc.wait(timeout=10)
+
+
+# -- data-plane hardening ----------------------------------------------------
+
+
+def test_concurrent_configures_do_not_clobber(devices):
+    """Two configure() calls racing on the SAME proxy (the dispatcher's
+    recovery path can reach this from two forward threads) must each get
+    their own ACK — generation-keyed handshake state, not a shared
+    per-stage dict."""
+    import queue as queue_mod
+
+    from adapt_tpu.comm.remote import RemoteWorkerProxy
+    from adapt_tpu.config import FaultConfig
+    from adapt_tpu.control.registry import WorkerRegistry
+    from adapt_tpu.graph import partition
+    from adapt_tpu.models.vit import vit_tiny
+
+    port = 17597
+    proc = spawn_worker_proc("--port", str(port), "--heartbeat", "0.1")
+    g = vit_tiny()
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = g.init(jax.random.PRNGKey(1), x)
+    plan = partition(g, ["encoder_block_1"])
+    stage_vars = plan.extract_variables(variables)
+
+    registry = WorkerRegistry(default_ttl_s=2.0).start()
+    results: "queue_mod.Queue" = queue_mod.Queue()
+    proxy = RemoteWorkerProxy(
+        "remote-cc",
+        ("127.0.0.1", port),
+        registry,
+        results,
+        model_config={
+            "model": "vit_tiny",
+            "num_classes": 10,
+            "cuts": ["encoder_block_1"],
+            "input_shape": [2, 32, 32, 3],
+        },
+        fault=FaultConfig(startup_wait_s=10.0, configure_timeout_s=60.0),
+    )
+    try:
+        proxy.start()
+        errors = []
+
+        def cfg(stage):
+            try:
+                proxy.configure(stage, None, stage_vars[stage])
+            except Exception as e:  # noqa: BLE001
+                errors.append((stage, e))
+
+        # Same stage twice concurrently + the other stage: all must land.
+        threads = [
+            threading.Thread(target=cfg, args=(s,)) for s in (1, 1, 0)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90.0)
+        assert not errors, errors
+        assert proxy.is_configured(0) and proxy.is_configured(1)
+    finally:
+        proxy.stop()
+        registry.stop()
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_stalled_peer_send_times_out_not_wedges():
+    """A peer that stops draining its socket (hung process, full TCP
+    buffers) must not wedge the sender forever: the bounded send raises
+    within ~send_timeout_s and the proxy marks its link dead so the
+    scheduler routes around it."""
+    import queue as queue_mod
+
+    from adapt_tpu.comm.remote import RemoteWorkerProxy
+    from adapt_tpu.config import FaultConfig
+    from adapt_tpu.control.registry import WorkerRegistry
+    from adapt_tpu.control.worker import Task, WorkerState
+
+    # A server that accepts and then never reads: sendall must eventually
+    # block once kernel buffers fill.
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    accepted = []
+
+    def accept_only():
+        conn, _ = srv.accept()
+        accepted.append(conn)  # keep alive, never read
+
+    t = threading.Thread(target=accept_only, daemon=True)
+    t.start()
+
+    registry = WorkerRegistry(default_ttl_s=5.0).start()
+    results: "queue_mod.Queue" = queue_mod.Queue()
+    proxy = RemoteWorkerProxy(
+        "remote-stall",
+        ("127.0.0.1", port),
+        registry,
+        results,
+        model_config={},
+        fault=FaultConfig(startup_wait_s=5.0, send_timeout_s=1.0),
+    )
+    try:
+        proxy.start()
+        proxy._sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+        big = np.zeros((4 << 20,), np.uint8)  # 4 MB >> buffer space
+        start = time.monotonic()
+        with pytest.raises((ConnectionError, TimeoutError)):
+            for _ in range(8):  # first sends may fit in buffers
+                proxy.submit(
+                    Task(request_id=1, stage_index=0, attempt=0, payload=big)
+                )
+        elapsed = time.monotonic() - start
+        assert elapsed < 10.0, f"send wedged for {elapsed:.1f}s"
+        # The link is condemned: state DEAD, membership eviction immediate.
+        assert proxy.state is WorkerState.DEAD
+        assert "remote-stall" not in registry.alive()
+    finally:
+        proxy.stop()
+        registry.stop()
+        for c in accepted:
+            c.close()
+        srv.close()
+
+
+# -- worker-initiated join (the pool can GROW) -------------------------------
+
+
+def test_worker_joins_running_pipeline_via_gateway(devices):
+    """The reference's defining adaptive capability: a FRESH worker
+    registers itself with a RUNNING pipeline (src/node_state.py:17-20) and
+    subsequently serves stages. Here: mid-stream, a new worker process
+    dials the WorkerGateway; after the local workers are crashed, requests
+    keep completing — only the joined worker can be serving them."""
+    from adapt_tpu.comm.remote import WorkerGateway
+    from adapt_tpu.config import CodecConfig, FaultConfig, ServeConfig
+    from adapt_tpu.control.dispatcher import Dispatcher
+    from adapt_tpu.graph import partition
+    from adapt_tpu.models.vit import vit_tiny
+
+    g = vit_tiny()
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = g.init(jax.random.PRNGKey(0), x)
+    plan = partition(g, ["encoder_block_1"])
+    y_ref = np.asarray(g.apply(variables, x))
+
+    cfg = ServeConfig(
+        fault=FaultConfig(
+            lease_ttl_s=1.0,
+            heartbeat_s=0.2,
+            task_deadline_s=30.0,
+            watchdog_period_s=0.1,
+            startup_wait_s=10.0,
+            configure_timeout_s=60.0,
+        ),
+        codec=CodecConfig(name="int8", weights="lz"),
+    )
+    disp = Dispatcher(plan, variables, config=cfg)
+    local = disp.spawn_workers(devices[:2])
+    gateway = WorkerGateway(
+        disp,
+        model_config={
+            "model": "vit_tiny",
+            "num_classes": 10,
+            "cuts": ["encoder_block_1"],
+            "input_shape": [2, 32, 32, 3],
+        },
+    )
+    proc = None
+    procs2: list = []
+    try:
+        disp.start()
+        gateway.start()
+        # Pipeline is live and serving before the newcomer exists.
+        outs = disp.serve_stream([x] * 3, timeout_per_request=60.0)
+        assert all(
+            np.max(np.abs(np.asarray(y) - y_ref)) < 0.3 for y in outs
+        )
+
+        proc = spawn_worker_proc(
+            "--connect", f"127.0.0.1:{gateway.port}",
+            "--worker-id", "joiner-0", "--heartbeat", "0.1",
+        )
+        deadline = time.monotonic() + 30.0
+        while "joiner-0" not in disp.registry.alive():
+            assert time.monotonic() < deadline, "worker never joined"
+            time.sleep(0.05)
+        # Pool grew mid-stream; keep serving through the join.
+        outs = disp.serve_stream([x] * 3, timeout_per_request=60.0)
+        assert all(
+            np.max(np.abs(np.asarray(y) - y_ref)) < 0.3 for y in outs
+        )
+        # A SECOND worker must also be able to join while a device-less
+        # remote proxy is already attached (regression: the join-watch
+        # prewarm read .device off every worker and crashed the gateway
+        # accept loop, capping the pool at one remote).
+        proc2 = spawn_worker_proc(
+            "--connect", f"127.0.0.1:{gateway.port}",
+            "--worker-id", "joiner-1", "--heartbeat", "0.1",
+        )
+        procs2.append(proc2)
+        deadline = time.monotonic() + 30.0
+        while "joiner-1" not in disp.registry.alive():
+            assert time.monotonic() < deadline, "second worker never joined"
+            time.sleep(0.05)
+        # Crash every local worker: only the joined remotes can serve now.
+        for w in local:
+            w.kill("crash")
+        deadline = time.monotonic() + 10.0
+        while any(w.worker_id in disp.registry.alive() for w in local):
+            assert time.monotonic() < deadline, "local leases never lapsed"
+            time.sleep(0.05)
+        outs = disp.serve_stream([x] * 2, timeout_per_request=90.0)
+        for y in outs:
+            assert np.max(np.abs(np.asarray(y) - y_ref)) < 0.3
+        assert "joiner-0" in disp.registry.alive()
+    finally:
+        for p in [proc, *procs2]:
+            if p is not None:
+                p.terminate()
+                p.wait(timeout=10)
+        gateway.stop()
+        disp.shutdown()

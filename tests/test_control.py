@@ -420,3 +420,58 @@ def test_hung_worker_quarantined_after_strikes(small_model, devices):
             time.sleep(0.05)
         with pipe.dispatcher._health_lock:
             assert victim.worker_id not in pipe.dispatcher._quarantined
+
+
+def test_timed_out_configure_cannot_install_late_binding(rng, devices):
+    """A configure that exceeds the handshake timeout is *cancelled*, not
+    just abandoned: when the slow transfer finally completes, the abort
+    token blocks the install, so the worker neither pins stage weights in
+    device memory nor reports is_configured for a binding the dispatcher
+    gave up on."""
+    import threading
+
+    from adapt_tpu.control.dispatcher import Dispatcher
+    from adapt_tpu.control.worker import StageWorker
+
+    g = LayerGraph("slowcfg")
+    g.add("dense0", nn.Dense(4), INPUT)
+    x = jnp.ones((1, 4))
+    variables = g.init(rng, x)
+    plan = partition(g, [])
+
+    config = ServeConfig(
+        fault=FaultConfig(
+            configure_timeout_s=0.3,
+            startup_wait_s=5.0,
+        )
+    )
+    disp = Dispatcher(plan, variables, config=config)
+
+    release = threading.Event()
+
+    class SlowWorker(StageWorker):
+        def configure(self, stage_index, fn, host_variables, spec=None, abort=None):
+            release.wait(5.0)  # simulate a weight transfer >> timeout
+            super().configure(
+                stage_index, fn, host_variables, spec=spec, abort=abort
+            )
+
+    w = SlowWorker(
+        worker_id="slow-0",
+        device=devices[0],
+        registry=disp.registry,
+        result_queue=disp.result_queue,
+        fault=config.fault,
+    )
+    disp.attach_worker(w)
+    disp.start()
+    try:
+        with pytest.raises(RequestFailed, match="timed out|no worker"):
+            disp.infer(x, timeout=5.0)
+        # Let the abandoned configure thread finish its slow transfer...
+        release.set()
+        time.sleep(0.5)
+        # ...and assert it did NOT install the binding afterwards.
+        assert not w.is_configured(0)
+    finally:
+        disp.shutdown()

@@ -142,3 +142,110 @@ def test_membership_fuzz_exactly_once(rng, devices):
     finally:
         tracer.enabled = False
         pipe.shutdown()
+
+
+def test_membership_fuzz_with_cross_host_join(rng, devices):
+    """Exactly-once must hold while the pool GROWS across hosts: mid-burst,
+    a remote worker process joins through the WorkerGateway while local
+    workers are being killed (the reference's scheduling pool grew and
+    shrank the same way, src/dispatcher.py:176-201 + node_state.py:17-20)."""
+    from adapt_tpu.comm.remote import WorkerGateway
+    from adapt_tpu.config import CodecConfig
+    from adapt_tpu.models.vit import vit_tiny
+
+    random.seed(99)
+    g = vit_tiny()
+    x0 = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = g.init(rng, x0)
+    from adapt_tpu.graph import partition as partition_fn
+
+    plan = partition_fn(g, ["encoder_block_1"])
+    config = ServeConfig(
+        max_inflight=8,
+        fault=FaultConfig(
+            lease_ttl_s=0.6,
+            heartbeat_s=0.15,
+            task_deadline_s=8.0,
+            watchdog_period_s=0.1,
+            startup_wait_s=5.0,
+            max_retries=4,
+            configure_timeout_s=30.0,
+        ),
+        codec=CodecConfig(name="bf16", weights="lz"),
+    )
+    from adapt_tpu.control.dispatcher import Dispatcher
+
+    disp = Dispatcher(plan, variables, config=config)
+    local = disp.spawn_workers(devices[:3])
+    gateway = WorkerGateway(
+        disp,
+        model_config={
+            "model": "vit_tiny",
+            "num_classes": 10,
+            "cuts": ["encoder_block_1"],
+            "input_shape": [2, 32, 32, 3],
+        },
+    )
+    full = jax.jit(g.apply)
+    y_ref = np.asarray(full(variables, x0))
+    procs = []
+    try:
+        disp.start()
+        gateway.start()
+        disp.warmup(x0)
+
+        futures = {}
+        n_requests = 24
+        for i in range(n_requests):
+            futures[i] = disp.submit(x0)
+            if i == 4:
+                # Pool grows: remote joiner dials in mid-burst.
+                from conftest import spawn_worker_proc
+
+                procs.append(
+                    spawn_worker_proc(
+                        "--connect", f"127.0.0.1:{gateway.port}",
+                        "--worker-id", "fuzz-joiner", "--heartbeat", "0.1",
+                    )
+                )
+            if i == 10:
+                # Pool shrinks: one local worker crashes, one hangs.
+                local[0].kill("crash")
+                local[1].kill("hang")
+            time.sleep(random.uniform(0.0, 0.05))
+
+        completed = failed = 0
+        for i, f in futures.items():
+            try:
+                y = f.result(timeout=120.0)
+                # bf16 activation codec on the remote hop: loose tolerance.
+                np.testing.assert_allclose(
+                    np.asarray(y), y_ref, rtol=0.1, atol=0.1
+                )
+                completed += 1
+            except Exception:
+                failed += 1
+        # Invariant 1: everything accounted for, none lost/duplicated.
+        assert completed + failed == n_requests
+        # Invariant 2: >= 1 worker always lived, so the stream survives.
+        assert completed >= n_requests * 0.9, (completed, failed)
+        # Invariant 3: the joiner actually became a member.
+        deadline = time.monotonic() + 20.0
+        while "fuzz-joiner" not in disp.registry.alive():
+            assert time.monotonic() < deadline, "joiner never registered"
+            time.sleep(0.05)
+        # Invariant 4: in-flight registry drains.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with disp._inflight_lock:
+                if not disp._inflight:
+                    break
+            time.sleep(0.05)
+        with disp._inflight_lock:
+            assert not disp._inflight
+    finally:
+        for p in procs:
+            p.terminate()
+            p.wait(timeout=10)
+        gateway.stop()
+        disp.shutdown()
