@@ -11,10 +11,40 @@ SPMD pipeline path in ``adapt_tpu.parallel`` (scan-over-blocks +
 
 from __future__ import annotations
 
+from collections.abc import Callable
+
 import flax.linen as nn
 import jax.numpy as jnp
 
 from adapt_tpu.graph.ir import INPUT, LayerGraph
+from adapt_tpu.ops.attention import flash_attention
+
+
+class MultiHeadSelfAttention(nn.Module):
+    """Self-attention on the fused Pallas flash kernel (``ops/attention``).
+
+    The product-path consumer of the kernel: qkv/out projections are flax
+    DenseGenerals (MXU matmuls), the softmax(QK^T)V core is
+    ``flash_attention`` — blockwise online-softmax in VMEM, O(S*D) memory
+    (and the jnp oracle for parity testing via ``attn_fn``)."""
+
+    heads: int
+    dtype: jnp.dtype = jnp.float32
+    attn_fn: Callable = staticmethod(flash_attention)
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        head_dim = d // self.heads
+        qkv = nn.DenseGeneral(
+            (3, self.heads, head_dim), dtype=self.dtype, name="qkv"
+        )(x)  # (b, s, 3, h, hd)
+        # -> three (b, h, s, hd) tensors for the kernel's layout.
+        q, k, v = jnp.moveaxis(qkv, 2, 0)
+        q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+        o = self.attn_fn(q, k, v)
+        o = jnp.swapaxes(o, 1, 2).reshape(b, s, d)
+        return nn.Dense(d, dtype=self.dtype, name="out")(o)
 
 
 class PatchEmbed(nn.Module):
@@ -61,11 +91,9 @@ class EncoderBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(dtype=self.dtype)(x)
-        y = nn.MultiHeadDotProductAttention(
-            num_heads=self.heads,
-            qkv_features=self.dim,
-            dtype=self.dtype,
-        )(y, y)
+        y = MultiHeadSelfAttention(
+            heads=self.heads, dtype=self.dtype, name="attn"
+        )(y)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)
         y = nn.Dense(self.mlp_dim, dtype=self.dtype)(y)

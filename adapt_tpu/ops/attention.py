@@ -91,9 +91,6 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, sm_scale):
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k")
-)
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -104,9 +101,48 @@ def flash_attention(
 ) -> jax.Array:
     """Fused attention over (batch, heads, seq, head_dim) tensors.
 
+    Differentiable: the forward pass is the Pallas kernel; the backward
+    pass recomputes scores with the jnp oracle (pallas_call defines no
+    VJP of its own, and recompute-in-backward is the flash-attention
+    memory story anyway — nothing S x S is saved between the passes).
+
     Falls back to :func:`attention_reference` when the sequence is not
     divisible by the block sizes (tiny/odd shapes).
     """
+    return _flash_vjp(q, k, v, causal, block_q, block_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_vjp(q, k, v, causal, block_q, block_k):
+    return _flash_impl(q, k, v, causal, block_q, block_k)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    return _flash_impl(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, residuals, do):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal),
+        q,
+        k,
+        v,
+    )
+    return vjp(do)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k")
+)
+def _flash_impl(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> jax.Array:
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
     block_q = min(block_q, s_q)
@@ -142,6 +178,9 @@ def flash_attention(
         interpret=jax.default_backend() != "tpu",
     )(qf, kf, vf)
     return out.reshape(b, h, s_q, d)
+
+
+_flash_vjp.defvjp(_flash_fwd, _flash_bwd)
 
 
 def attention_reference(

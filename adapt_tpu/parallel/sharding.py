@@ -33,11 +33,14 @@ def replicate(tree, mesh: Mesh):
 #: Tensor-parallel placement rules for the ViT encoder blocks
 #: (``models/vit.py``): megatron-style — qkv/mlp-in column-split over 'tp',
 #: attn-out/mlp-out row-split, so each block needs exactly one psum pair
-#: (inserted automatically by GSPMD).
+#: (inserted automatically by GSPMD). Param paths follow
+#: ``MultiHeadSelfAttention`` (fused ``attn/qkv`` DenseGeneral with a
+#: (d, 3, heads, head_dim) kernel — heads axis is the column split — and a
+#: 2-D ``attn/out`` row-split on the contracted d = heads*head_dim).
 _VIT_TP_PATTERNS: list[tuple[str, tuple]] = [
-    (r"encoder_block.*(query|key|value).*kernel", (None, None, "tp")),
-    (r"encoder_block.*(query|key|value).*bias", (None, "tp")),
-    (r"encoder_block.*out.*kernel", ("tp", None, None)),
+    (r"encoder_block.*attn/qkv/kernel", (None, None, "tp", None)),
+    (r"encoder_block.*attn/qkv/bias", (None, "tp", None)),
+    (r"encoder_block.*attn/out/kernel", ("tp", None)),
     (r"encoder_block.*Dense_0.*kernel", (None, "tp")),  # mlp in
     (r"encoder_block.*Dense_0.*bias", ("tp",)),
     (r"encoder_block.*Dense_1.*kernel", ("tp", None)),  # mlp out

@@ -199,3 +199,38 @@ def test_ulysses_with_flash_block(sp_mesh):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
+
+
+def test_vit_tp_rules_cover_attention_params(rng, devices):
+    """Every encoder-block matmul weight must get a real TP split —
+    regression for the attention-module rename silently falling through to
+    replicated (P()) because the rules still matched flax's old
+    query/key/value param names."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from adapt_tpu.models.vit import vit_tiny
+    from adapt_tpu.parallel.sharding import tree_shardings
+
+    g = vit_tiny()
+    variables = g.init(rng, jnp.ones((1, 32, 32, 3)))
+    mesh = Mesh(np.array(devices[:2]).reshape(1, 2), ("dp", "tp"))
+    shardings = tree_shardings(variables, mesh)
+
+    flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
+    specs = {
+        "/".join(str(getattr(k, "key", k)) for k in path): s.spec
+        for path, s in flat
+    }
+    block = {p: s for p, s in specs.items() if "encoder_block_0" in p}
+    assert block, "no encoder block params found"
+    # Attention qkv column-split on the heads axis, out row-split.
+    qkv_kernel = next(s for p, s in block.items() if "attn/qkv/kernel" in p)
+    assert "tp" in tuple(qkv_kernel), qkv_kernel
+    out_kernel = next(s for p, s in block.items() if "attn/out/kernel" in p)
+    assert out_kernel == P("tp", None), out_kernel
+    # MLP in/out splits still live.
+    mlp_in = next(s for p, s in block.items() if "Dense_0/kernel" in p)
+    assert mlp_in == P(None, "tp"), mlp_in
+    mlp_out = next(s for p, s in block.items() if "Dense_1/kernel" in p)
+    assert mlp_out == P("tp", None), mlp_out
