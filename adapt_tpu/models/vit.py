@@ -35,6 +35,10 @@ class MultiHeadSelfAttention(nn.Module):
     @nn.compact
     def __call__(self, x):
         b, s, d = x.shape
+        if d % self.heads:
+            raise ValueError(
+                f"model dim {d} not divisible by {self.heads} heads"
+            )
         head_dim = d // self.heads
         qkv = nn.DenseGeneral(
             (3, self.heads, head_dim), dtype=self.dtype, name="qkv"

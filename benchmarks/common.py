@@ -48,3 +48,56 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
             }
         )
     )
+
+
+def measure_scan_throughput(
+    graph, x0, iters: int, trials: int
+) -> tuple[float, list[float]]:
+    """The one honest timed region for this image (shared by ``bench.py``,
+    ``local_infer.py`` and ``tpu_models.py``): ITERS forward passes of
+    ``graph`` inside one jitted ``lax.scan`` whose carry makes every
+    iteration data-dependent on the last (defeats LICM and the tunnel's
+    (fn, args) dedup), timed around a host fetch. Returns
+    (images_per_sec, per-trial wall seconds)."""
+    import statistics
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    variables = jax.jit(graph.init)(jax.random.PRNGKey(0), x0)
+
+    def bench_fn(variables, x):
+        def body(x, _):
+            y = graph.apply(variables, x)
+            x = x * 0.999 + (jnp.mean(y) * 1e-6).astype(x.dtype)
+            return x, y[0, 0]
+
+        x, ys = lax.scan(body, x, None, length=iters)
+        return jnp.mean(ys)
+
+    fwd = jax.jit(bench_fn)
+    np.asarray(fwd(variables, x0))  # compile + warm
+
+    times = []
+    for i in range(trials):
+        x_trial = x0 + (i + 1) * 1e-6  # distinct per trial (dedup)
+        t0 = time.perf_counter()
+        np.asarray(fwd(variables, x_trial))
+        times.append(time.perf_counter() - t0)
+    dt = statistics.median(times)
+    return x0.shape[0] * iters / dt, times
+
+
+def int_flag(argv: list[str], name: str, default: int) -> int:
+    """Parse ``--name N`` from argv; malformed/missing values fall back to
+    the default instead of raising — bench.py's 'always print one JSON
+    line, exit 0' contract must survive bad CLI input."""
+    if name in argv:
+        try:
+            return int(argv[argv.index(name) + 1])
+        except (IndexError, ValueError):
+            pass
+    return default

@@ -84,6 +84,7 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default="resnet50-3stage")
     parser.add_argument("--requests", type=int, default=REQUESTS)
+    parser.add_argument("--batch", type=int, default=BATCH)
     args = parser.parse_args()
 
     graph, cuts, hop = build(args.config)
@@ -95,7 +96,8 @@ def main() -> None:
     from adapt_tpu.graph.partition import partition
     from adapt_tpu.runtime.pipeline import LocalPipeline
 
-    x0 = jax.numpy.ones((BATCH, 224, 224, 3), jax.numpy.float32)
+    hw = 380 if args.config == "effnetb4-dag" else 224
+    x0 = jax.numpy.ones((args.batch, hw, hw, 3), jax.numpy.float32)
     variables = jax.jit(graph.init)(jax.random.PRNGKey(0), x0)
     plan = partition(graph, cuts)
     pipe = LocalPipeline(

@@ -4,25 +4,48 @@ BASELINE.md config 5 ("ViT encoder split by transformer block,
 kill-one-stage fault-injection") and the second headline target:
 recovery-to-serve < 2 s after one node kill.
 
-Runs on the virtual CPU mesh: recovery time is a *control-plane* metric
-(failure detection via lease expiry + re-bind + replay of retained
-payloads), not a compute metric, and only the CPU backend gives honest
+Two configs:
+
+  --config vit-tiny          4-stage ViT-tiny (control-plane floor: stage
+                             weights are KB-scale, so the number isolates
+                             detection + scheduling latency)
+  --config resnet152-8stage  ResNet-152 in 8 balanced stages — the scale
+                             BASELINE.md's <2 s budget was written for:
+                             a failover re-bind pays a real multi-MB
+                             stage-weight device_put, not a toy one
+
+Runs on the virtual CPU mesh: recovery time is a *control-plane + weight
+movement* metric, not an MXU metric, and only the CPU backend gives honest
 ``block_until_ready`` semantics in this image (see benchmarks/common.py).
 
-Definition measured here: from the moment a worker is killed (crash mode:
-stops heartbeating AND swallows queued tasks — the reference's machine
-death, detected only by lease expiry like etcd's ``/workers/<ip>``,
+Definition measured: from the moment a worker is killed (crash mode: stops
+heartbeating AND swallows queued tasks — the reference's machine death,
+detected only by lease expiry like etcd's ``/workers/<ip>``,
 ``/root/reference/src/node_state.py:16-20``) until EVERY request that was
-in flight at kill time has completed successfully. That includes the
-worst case: tasks sitting in the dead worker's queue must wait out the
-lease TTL, be re-dispatched by the membership watcher, and re-run.
+in flight at kill time has completed successfully. Includes the worst
+case: tasks in the dead worker's queue wait out the lease TTL, get
+re-dispatched by the membership watcher, and re-run.
 
-Prints one JSON line; vs_baseline = 2.0 / median_recovery_s (>1 beats the
+Breakdown per trial (also written to ``--out`` as a JSON artifact):
+  detect_s    kill -> membership 'leave' event (lease expiry + reaper)
+  rebind_s    kill -> first stage configure completed on a surviving worker
+              after the kill (the weight device_put failover actually paid)
+  total_s     kill -> all in-flight requests completed
+  control_s   drain time of an identical burst with NO kill (same trial)
+  overhead_s  (submit->done with kill) - control_s: what the kill actually
+              cost end-to-end. On the CPU mesh total_s is dominated by
+              re-running real stage compute on shared host cores; on
+              per-stage TPU chips that replay is milliseconds, so
+              detect+rebind+overhead is the hardware-transferable number.
+
+Prints one JSON line; vs_baseline = 2.0 / median_total_s (>1 beats the
 <2 s target).
 """
 
 from __future__ import annotations
 
+import argparse
+import json
 import statistics
 import sys
 import time
@@ -31,59 +54,119 @@ sys.path.insert(0, ".")  # repo root
 
 from benchmarks.common import distinct_inputs, emit, force_cpu_mesh  # noqa: E402
 
-N_DEVICES = 8
-N_STAGES = 4
-BURST = 8
-TRIALS = 4
 TARGET_S = 2.0
+
+CONFIGS = {
+    # name: (n_devices, n_stages, burst, trials)
+    "vit-tiny": (8, 4, 8, 4),
+    "resnet152-8stage": (8, 8, 6, 3),
+}
+
+
+def _build(config: str):
+    import jax
+
+    if config == "vit-tiny":
+        from adapt_tpu.models.vit import vit_tiny
+
+        graph = vit_tiny()
+        x0 = jax.numpy.ones((1, 32, 32, 3), jax.numpy.float32)
+        cuts = [f"encoder_block_{i}" for i in range(1, CONFIGS[config][1])]
+    else:
+        from adapt_tpu.graph.partition import balanced_cuts
+        from adapt_tpu.models.resnet import resnet152
+
+        graph = resnet152(num_classes=1000, dtype=jax.numpy.float32)
+        x0 = jax.numpy.ones((1, 224, 224, 3), jax.numpy.float32)
+        cuts = balanced_cuts(graph, CONFIGS[config][1])
+    return graph, x0, cuts
 
 
 def main() -> None:
-    force_cpu_mesh(N_DEVICES)
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="vit-tiny", choices=sorted(CONFIGS))
+    parser.add_argument("--out", default=None, help="write per-trial JSON here")
+    args = parser.parse_args()
+    n_devices, n_stages, burst, trials = CONFIGS[args.config]
+
+    force_cpu_mesh(n_devices)
     import jax
 
     from adapt_tpu.config import FaultConfig, ServeConfig
     from adapt_tpu.control.worker import WorkerState
     from adapt_tpu.graph.partition import partition
-    from adapt_tpu.models.vit import vit_tiny
     from adapt_tpu.runtime.pipeline import ServingPipeline
 
-    graph = vit_tiny()
-    x0 = jax.numpy.ones((1, 32, 32, 3), jax.numpy.float32)
+    graph, x0, cuts = _build(args.config)
     variables = jax.jit(graph.init)(jax.random.PRNGKey(0), x0)
-    cuts = [f"encoder_block_{i}" for i in range(1, N_STAGES)]
     plan = partition(graph, cuts)
 
     # Production-shaped fault config: sub-second failure detection, the
-    # task deadline safely above per-request latency.
+    # task deadline safely above per-request latency (ResNet-152 stages on
+    # CPU take real time per request).
     config = ServeConfig(
-        max_inflight=BURST * 2,
+        max_inflight=burst * 2,
         fault=FaultConfig(
             lease_ttl_s=0.5,
             heartbeat_s=0.1,
-            task_deadline_s=5.0,
+            task_deadline_s=30.0,
             watchdog_period_s=0.05,
-            startup_wait_s=5.0,
+            startup_wait_s=10.0,
             max_retries=3,
-            configure_timeout_s=30.0,
+            configure_timeout_s=120.0,
         ),
     )
 
-    recoveries = []
-    for trial in range(TRIALS):
+    trials_out = []
+    for trial in range(trials):
         pipe = ServingPipeline(
-            plan, variables, devices=jax.devices()[:N_DEVICES], config=config
+            plan, variables, devices=jax.devices()[:n_devices], config=config
         ).start()
         try:
+            # Breakdown hooks: membership 'leave' time + configure
+            # completion times (a configure after the kill = the failover
+            # re-bind paying its weight transfer).
+            events = {"leave": None, "configures": []}
+
+            def on_member(event, wid, _ev=events):
+                if event == "leave" and _ev["leave"] is None:
+                    _ev["leave"] = time.monotonic()
+
+            pipe.registry.watch(on_member)
+            for w in pipe.workers:
+                orig = w.configure
+
+                def timed(
+                    *a, _orig=orig, _w=w, _ev=events, **kw
+                ):
+                    r = _orig(*a, **kw)
+                    _ev["configures"].append(
+                        (time.monotonic(), _w.worker_id, a[0])
+                    )
+                    return r
+
+                w.configure = timed
+
             pipe.warmup(x0)
-            xs = distinct_inputs(
-                jax.random.PRNGKey(100 + trial), x0.shape, BURST
+            # Control burst: identical load, no kill — isolates the cost
+            # of the failure from the cost of the compute itself.
+            xs_ctrl = distinct_inputs(
+                jax.random.PRNGKey(500 + trial), x0.shape, burst
             )
+            t_ctrl = time.monotonic()
+            for f in [pipe.dispatcher.submit(x) for x in xs_ctrl]:
+                f.result(timeout=300.0)
+            control_s = time.monotonic() - t_ctrl
+
+            xs = distinct_inputs(
+                jax.random.PRNGKey(100 + trial), x0.shape, burst
+            )
+            t_submit = time.monotonic()
             futures = [pipe.dispatcher.submit(x) for x in xs]
             # Pick a victim that is actually involved: busy or has queued
             # tasks, so its in-flight work must be detected and replayed.
             victim = None
-            deadline = time.monotonic() + 5.0
+            deadline = time.monotonic() + 10.0
             while victim is None and time.monotonic() < deadline:
                 for w in pipe.workers:
                     if w.state is WorkerState.BUSY or w.queue_depth > 0:
@@ -94,22 +177,62 @@ def main() -> None:
                 victim = next(
                     w
                     for w in pipe.workers
-                    if any(w.is_configured(s) for s in range(N_STAGES))
+                    if any(w.is_configured(s) for s in range(n_stages))
                 )
             t0 = time.monotonic()
             victim.kill("crash")
             for f in futures:
-                f.result(timeout=30.0)
-            recoveries.append(time.monotonic() - t0)
+                f.result(timeout=300.0)
+            t_done = time.monotonic()
+            total = t_done - t0
+            detect = (events["leave"] - t0) if events["leave"] else None
+            post_kill = [t for (t, _, _) in events["configures"] if t > t0]
+            rebind = (min(post_kill) - t0) if post_kill else None
+            trials_out.append(
+                {
+                    "trial": trial,
+                    "victim": victim.worker_id,
+                    "detect_s": detect,
+                    "rebind_s": rebind,
+                    "total_s": total,
+                    "control_s": control_s,
+                    "overhead_s": (t_done - t_submit) - control_s,
+                }
+            )
         finally:
             pipe.shutdown()
 
-    rec = statistics.median(recoveries)
+    med = statistics.median(t["total_s"] for t in trials_out)
+    artifact = {
+        "config": args.config,
+        "n_devices": n_devices,
+        "n_stages": n_stages,
+        "burst": burst,
+        "backend": "cpu-virtual-mesh",
+        "lease_ttl_s": config.fault.lease_ttl_s,
+        "trials": trials_out,
+        "median_total_s": med,
+        "median_detect_s": statistics.median(
+            t["detect_s"] for t in trials_out if t["detect_s"] is not None
+        )
+        if any(t["detect_s"] is not None for t in trials_out)
+        else None,
+        "median_overhead_s": statistics.median(
+            t["overhead_s"] for t in trials_out
+        ),
+        "rebinds_observed": sum(
+            1 for t in trials_out if t["rebind_s"] is not None
+        ),
+        "target_s": TARGET_S,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=1)
     emit(
-        "recovery_to_serve_after_kill_s",
-        rec,
+        f"recovery_to_serve_{args.config}_s",
+        med,
         "seconds",
-        TARGET_S / rec if rec > 0 else float("inf"),
+        TARGET_S / med if med > 0 else float("inf"),
     )
 
 
