@@ -57,6 +57,30 @@ class ResNetStem(nn.Module):
         )
 
 
+class SpaceToDepthStem(nn.Module):
+    """MXU-friendly stem: 2x2 space-to-depth of the image, then a 4x4/s1
+    conv (same receptive field class and output shape as the 7x7/s2 conv,
+    but stride-1 with 12 input channels instead of a strided conv over 3 —
+    the standard TPU ResNet stem transform). Same maxpool after."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        b, h, w, c = x.shape
+        if h % 2 or w % 2:
+            raise ValueError(f"space-to-depth stem needs even H/W, got {h}x{w}")
+        # (b, h, w, c) -> (b, h/2, w/2, 4c): each output pixel carries its
+        # 2x2 input neighborhood, so stride-2 convs become stride-1.
+        x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+        x = ConvBN(64, (4, 4), strides=1, dtype=self.dtype)(x)
+        return nn.max_pool(
+            x, window_shape=(3, 3), strides=(2, 2), padding="SAME"
+        )
+
+
 class BottleneckBranch(nn.Module):
     """The residual branch of a ResNet bottleneck block: 1x1 -> 3x3 -> 1x1
     (x4 filters), no activation after the last BN (the add supplies it)."""

@@ -20,6 +20,7 @@ from adapt_tpu.models.layers import (
     ClassifierHead,
     Projection,
     ResNetStem,
+    SpaceToDepthStem,
 )
 
 #: blocks per stage (conv2..conv5), Keras ResNetXX layouts.
@@ -39,11 +40,19 @@ def resnet(
     depth: int,
     num_classes: int = 1000,
     dtype: jnp.dtype = jnp.float32,
+    stem: str = "conv7",
 ) -> LayerGraph:
+    """``stem='s2d'`` swaps the 7x7/s2 stem conv for the space-to-depth
+    + 4x4/s1 form (``layers.SpaceToDepthStem``) — same downsampling and
+    receptive-field class, far better MXU tiling for the first conv. Cut
+    names are unchanged (the stem is one node either way)."""
     if depth not in _DEPTHS:
         raise ValueError(f"unsupported ResNet depth {depth}; have {list(_DEPTHS)}")
+    stems = {"conv7": ResNetStem, "s2d": SpaceToDepthStem}
+    if stem not in stems:
+        raise ValueError(f"unknown stem {stem!r}; have {sorted(stems)}")
     g = LayerGraph(f"resnet{depth}")
-    g.add("stem", ResNetStem(dtype=dtype), INPUT)
+    g.add("stem", stems[stem](dtype=dtype), INPUT)
     prev = "stem"
     for stage_idx, (blocks, filters) in enumerate(
         zip(_DEPTHS[depth], _FILTERS), start=2
@@ -69,16 +78,28 @@ def resnet(
     return g
 
 
-def resnet50(num_classes: int = 1000, dtype: jnp.dtype = jnp.float32) -> LayerGraph:
-    return resnet(50, num_classes, dtype)
+def resnet50(
+    num_classes: int = 1000,
+    dtype: jnp.dtype = jnp.float32,
+    stem: str = "conv7",
+) -> LayerGraph:
+    return resnet(50, num_classes, dtype, stem=stem)
 
 
-def resnet101(num_classes: int = 1000, dtype: jnp.dtype = jnp.float32) -> LayerGraph:
-    return resnet(101, num_classes, dtype)
+def resnet101(
+    num_classes: int = 1000,
+    dtype: jnp.dtype = jnp.float32,
+    stem: str = "conv7",
+) -> LayerGraph:
+    return resnet(101, num_classes, dtype, stem=stem)
 
 
-def resnet152(num_classes: int = 1000, dtype: jnp.dtype = jnp.float32) -> LayerGraph:
-    return resnet(152, num_classes, dtype)
+def resnet152(
+    num_classes: int = 1000,
+    dtype: jnp.dtype = jnp.float32,
+    stem: str = "conv7",
+) -> LayerGraph:
+    return resnet(152, num_classes, dtype, stem=stem)
 
 
 #: BASELINE.json config 2: "ResNet-50 split at conv3_block1/conv4_block1

@@ -43,6 +43,24 @@ class _StageError:
         self.exc = exc
 
 
+def codec_hop_transform(codec_cfg):
+    """Build a ``hop_transform`` from a :class:`~adapt_tpu.config.
+    CodecConfig`: every activation hop pays the codec round-trip, the
+    reference's zfp+lz4-per-hop cost model (``src/dispatcher.py:92-98``).
+    Returns None for the 'none' codec — in-process hops are
+    device-to-device and need no transform at all."""
+    from adapt_tpu.comm.codec import get_codec, pack, unpack
+
+    if codec_cfg.name == "none":
+        return None
+    codec = get_codec(codec_cfg.name, tolerance=codec_cfg.tolerance)
+
+    def hop(activation, stage_index):
+        return unpack(pack(codec, activation))
+
+    return hop
+
+
 class LocalPipeline:
     """Static-chain pipelined inference over a device list."""
 
@@ -64,6 +82,27 @@ class LocalPipeline:
         self.hop_transform = hop_transform
         self.stages: list[CompiledStage] = compile_stages(
             plan, variables, devices, donate_activations=donate_activations
+        )
+
+    @classmethod
+    def from_config(
+        cls,
+        plan: PartitionPlan,
+        variables,
+        devices: Sequence[jax.Device] | None = None,
+        config: ServeConfig | None = None,
+        donate_activations: bool = False,
+    ) -> "LocalPipeline":
+        """LocalPipeline with the hop transform derived from
+        ``config.codec`` — the one knob that also configures every
+        gateway-joined remote worker (``comm.remote.WorkerGateway``)."""
+        config = config or ServeConfig()
+        return cls(
+            plan,
+            variables,
+            devices=devices,
+            donate_activations=donate_activations,
+            hop_transform=codec_hop_transform(config.codec),
         )
 
     def infer(self, x) -> jax.Array:

@@ -47,7 +47,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from benchmarks.common import int_flag  # noqa: E402  (imports no JAX)
+from benchmarks.common import int_flag, str_flag  # noqa: E402  (no JAX)
 
 A100_IMAGES_PER_SEC = 3000.0  # single-A100 fp16 bs32, framework-level
 RESNET50_FLOPS_PER_IMAGE = 8.2e9  # fwd pass @224x224, mul+add as 2
@@ -65,14 +65,20 @@ ATTEMPTS = [
 ]
 
 
-def _child(platform: str, iters: int, trials: int, batch: int = BATCH) -> None:
+def _child(
+    platform: str,
+    iters: int,
+    trials: int,
+    batch: int = BATCH,
+    stem: str = "conv7",
+) -> None:
     import jax
     import jax.numpy as jnp
 
     from adapt_tpu.models.resnet import resnet50
     from benchmarks.common import measure_scan_throughput
 
-    graph = resnet50(num_classes=1000, dtype=jnp.bfloat16)
+    graph = resnet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem)
     x0 = jax.random.normal(
         jax.random.PRNGKey(0), (batch, 224, 224, 3), jnp.float32
     )
@@ -81,7 +87,8 @@ def _child(platform: str, iters: int, trials: int, batch: int = BATCH) -> None:
         # The headline metric name is the bs=32 contract; off-headline
         # sweep rows are labeled by their actual batch (and vs_baseline
         # still divides by the bs=32 A100 constant — noted in-band).
-        "metric": f"resnet50_bs{batch}_images_per_sec_per_chip",
+        "metric": f"resnet50_bs{batch}_images_per_sec_per_chip"
+        + ("" if stem == "conv7" else f"_{stem}"),
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / A100_IMAGES_PER_SEC, 4),
@@ -108,13 +115,15 @@ def main() -> int:
         iters = int_flag(sys.argv, "--iters", 100)
         trials = int_flag(sys.argv, "--trials", 3)
         batch = int_flag(sys.argv, "--batch", BATCH)
-        _child(platform, iters, trials, batch)
+        stem = str_flag(sys.argv, "--stem", "conv7", choices=("conv7", "s2d"))
+        _child(platform, iters, trials, batch, stem)
         return 0
 
     # Optional batch override (default 32 = the headline config; the batch
     # sweep artifact uses this knob, the driver never passes it). Guarded
     # parse: bad CLI input must not break the one-JSON-line contract.
     batch = int_flag(sys.argv, "--batch", BATCH)
+    stem = str_flag(sys.argv, "--stem", "conv7", choices=("conv7", "s2d"))
     notes: list[str] = []
     for platform, iters, trials, timeout_s, backoff_s in ATTEMPTS:
         if backoff_s:
@@ -137,6 +146,8 @@ def main() -> int:
             str(trials),
             "--batch",
             str(batch),
+            "--stem",
+            stem,
         ]
         t0 = time.time()
         try:
@@ -191,7 +202,8 @@ def main() -> int:
     print(
         json.dumps(
             {
-                "metric": "resnet50_bs32_images_per_sec_per_chip",
+                "metric": f"resnet50_bs{batch}_images_per_sec_per_chip"
+                + ("" if stem == "conv7" else f"_{stem}"),
                 "value": 0.0,
                 "unit": "images/sec",
                 "vs_baseline": 0.0,

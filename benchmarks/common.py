@@ -101,3 +101,18 @@ def int_flag(argv: list[str], name: str, default: int) -> int:
         except (IndexError, ValueError):
             pass
     return default
+
+
+def str_flag(
+    argv: list[str], name: str, default: str, choices: tuple[str, ...] | None = None
+) -> str:
+    """Parse ``--name VALUE``; missing values, values that look like the
+    next flag, or values outside ``choices`` fall back to the default
+    (same always-emit contract as :func:`int_flag`)."""
+    if name in argv:
+        idx = argv.index(name) + 1
+        if idx < len(argv) and not argv[idx].startswith("--"):
+            value = argv[idx]
+            if choices is None or value in choices:
+                return value
+    return default

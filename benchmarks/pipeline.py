@@ -67,17 +67,11 @@ def build(config: str):
 def _int8_hop():
     """Int8 quantization round-trip on every activation hop — what the
     reference pays with zfp+lz4 on every socket hop (``src/dispatcher.py:
-    92-98``), expressed as the TPU-native DCN-boundary codec."""
-    import numpy as np
+    92-98``), expressed through the framework's own codec routing."""
+    from adapt_tpu.config import CodecConfig
+    from adapt_tpu.runtime.pipeline import codec_hop_transform
 
-    from adapt_tpu.comm.codec import get_codec, pack, unpack
-
-    codec = get_codec("int8")
-
-    def hop(activation, stage_index):
-        return unpack(pack(codec, np.asarray(activation)))
-
-    return hop
+    return codec_hop_transform(CodecConfig(name="int8"))
 
 
 def main() -> None:

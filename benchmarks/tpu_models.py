@@ -113,9 +113,18 @@ def main() -> int:
             None,
         )
         if proc.returncode == 0 and line:
-            print(line, flush=True)
-            return 0
-        err = (proc.stderr or proc.stdout or "").strip()[-300:]
+            record = json.loads(line)
+            if record.get("platform") == "cpu":
+                # Silent CPU fallback inside a TPU measurement: reject —
+                # a CPU number labeled as chip throughput would read as a
+                # perf regression instead of an environment failure
+                # (bench.py's contract, bench.py:189-193).
+                err = "TPU run silently fell back to the CPU backend"
+            else:
+                print(line, flush=True)
+                return 0
+        else:
+            err = (proc.stderr or proc.stdout or "").strip()[-300:]
     except subprocess.TimeoutExpired:
         err = "child timed out after 900s (TPU relay hang?)"
     print(

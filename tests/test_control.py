@@ -475,3 +475,80 @@ def test_timed_out_configure_cannot_install_late_binding(rng, devices):
         assert not w.is_configured(0)
     finally:
         disp.shutdown()
+
+
+def test_registry_lease_tokens_protect_replacement():
+    """A stale holder's deregister must not evict a replacement that
+    re-registered the same worker id (etcd lease-id semantics): the
+    ownership token decides, under the same lock that deletes."""
+    reg = WorkerRegistry(default_ttl_s=5.0)
+    old_token = reg.register("w", ttl_s=5.0)
+    new_token = reg.register("w", ttl_s=5.0)  # replacement takes the id
+    assert new_token != old_token
+    reg.deregister("w", token=old_token)  # stale holder dies late
+    assert "w" in reg.alive(), "stale deregister evicted the replacement"
+    reg.deregister("w", token=new_token)  # owner may always deregister
+    assert "w" not in reg.alive()
+    # Tokenless deregister stays unconditional (in-process workers).
+    reg.register("w2")
+    reg.deregister("w2")
+    assert "w2" not in reg.alive()
+
+
+def test_unconfigure_generation_scoped(rng, devices):
+    """A revoke is scoped to the configure that earned it: undoing an
+    abandoned handshake must not drop a newer configure's binding."""
+    import queue as queue_mod
+
+    from adapt_tpu.control.worker import StageWorker
+
+    reg = WorkerRegistry()
+    w = StageWorker(
+        worker_id="w0",
+        device=devices[0],
+        registry=reg,
+        result_queue=queue_mod.Queue(),
+    )
+    g = LayerGraph("ucfg")
+    g.add("dense0", nn.Dense(4), INPUT)
+    variables = g.init(rng, jnp.ones((1, 4)))
+    plan = partition(g, [])
+    fn = plan.stage_apply(plan.stages[0])
+
+    gen1 = w.configure(0, fn, variables)
+    gen2 = w.configure(0, fn, variables)  # newer configure, same stage
+    assert gen2 > gen1
+    w.unconfigure(0, gen1)  # stale revoke: must be a no-op
+    assert w.is_configured(0)
+    w.unconfigure(0, gen2)  # owning revoke: drops the binding
+    assert not w.is_configured(0)
+    # Unconditional revoke works regardless of generation.
+    gen3 = w.configure(0, fn, variables)
+    assert gen3 > gen2
+    w.unconfigure(0)
+    assert not w.is_configured(0)
+
+
+def test_local_pipeline_from_config_codec_hop(small_model, devices):
+    """ServeConfig.codec drives LocalPipeline hops: with a lossy int8
+    codec the pipeline output differs from exact but stays within
+    quantization error; with 'none' there is no transform at all."""
+    from adapt_tpu.config import CodecConfig
+    from adapt_tpu.runtime.pipeline import LocalPipeline
+
+    g, variables, plan, x = small_model
+    exact = np.asarray(g.apply(variables, x))
+
+    cfg = ServeConfig(codec=CodecConfig(name="int8"))
+    pipe = LocalPipeline.from_config(plan, variables, devices[:3], cfg)
+    assert pipe.hop_transform is not None
+    y = np.asarray(pipe.infer(x))
+    assert np.max(np.abs(y - exact)) < 0.1 * max(1.0, np.max(np.abs(exact)))
+
+    pipe_none = LocalPipeline.from_config(
+        plan, variables, devices[:3], ServeConfig()
+    )
+    assert pipe_none.hop_transform is None
+    np.testing.assert_allclose(
+        np.asarray(pipe_none.infer(x)), exact, rtol=1e-6
+    )

@@ -126,3 +126,22 @@ def test_vit_attention_flash_matches_oracle(rng):
     assert all(
         bool(jnp.all(jnp.isfinite(leaf))) for leaf in jax.tree.leaves(g)
     )
+
+
+def test_resnet_space_to_depth_stem(rng):
+    """The MXU-friendly s2d stem must produce the same output shape as the
+    7x7/s2 stem, keep every cut name valid, and reject odd inputs."""
+    from adapt_tpu.models.resnet import RESNET50_3STAGE_CUTS, resnet50
+
+    g = resnet50(num_classes=10, stem="s2d")
+    x = jnp.ones((1, 64, 64, 3))
+    v = jax.jit(g.init)(rng, x)
+    y = jax.jit(g.apply)(v, x)
+    assert y.shape == (1, 10)
+    # Cut names unchanged: the baseline 3-stage plan still partitions.
+    plan = partition(g, list(RESNET50_3STAGE_CUTS))
+    assert plan.num_stages == 3
+    with pytest.raises(ValueError, match="unknown stem"):
+        resnet50(stem="bogus")
+    with pytest.raises(ValueError, match="even"):
+        g.apply(v, jnp.ones((1, 63, 63, 3)))
