@@ -119,13 +119,24 @@ class RemoteStageServer:
         from adapt_tpu.graph.partition import partition
         from adapt_tpu.models import MODEL_REGISTRY
 
+        model_kwargs = cfg.get("model_kwargs", {})
         key = json.dumps(
-            [cfg["model"], cfg.get("num_classes", 1000), cfg["cuts"]],
+            [
+                cfg["model"],
+                cfg.get("num_classes", 1000),
+                cfg["cuts"],
+                model_kwargs,
+            ],
             sort_keys=True,
         )
         if key not in self._graph_cache:
             factory, default_shape = MODEL_REGISTRY[cfg["model"]]
-            graph = factory(num_classes=cfg.get("num_classes", 1000))
+            # model_kwargs: extra factory arguments (e.g. resnet50's
+            # stem="s2d") — the joiner must rebuild the EXACT graph the
+            # dispatcher partitioned or the streamed weights won't fit.
+            graph = factory(
+                num_classes=cfg.get("num_classes", 1000), **model_kwargs
+            )
             plan = partition(graph, cfg["cuts"])
             input_shape = cfg.get("input_shape") or [1, *default_shape]
             template = jax.eval_shape(

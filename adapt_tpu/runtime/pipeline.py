@@ -207,7 +207,20 @@ class LocalPipeline:
 
 
 class ServingPipeline:
-    """Adaptive serving: dispatcher + workers + membership + watchdog."""
+    """Adaptive serving: dispatcher + workers + membership + watchdog.
+
+    ``gateway_model_config`` (optional) makes the pipeline *elastic*: a
+    ``comm.remote.WorkerGateway`` starts with the dispatcher, and any
+    machine can then join the pool at runtime with
+    ``python -m adapt_tpu.comm.remote --connect HOST:{gateway_port}`` —
+    the reference's worker-self-registration story
+    (``src/node_state.py:17-20``) as one constructor argument. The dict is
+    the model recipe joiners rebuild stages from (``model``, ``cuts``,
+    ``num_classes``, ``input_shape``, and any extra factory arguments
+    under ``model_kwargs`` — e.g. ``{"stem": "s2d"}`` — see
+    ``RemoteStageServer._build_stage``); codecs come from
+    ``config.codec``. The recipe must rebuild the exact graph this
+    pipeline's ``plan`` partitioned, or joiners' weights won't fit."""
 
     def __init__(
         self,
@@ -215,6 +228,9 @@ class ServingPipeline:
         variables,
         devices: Sequence[jax.Device] | None = None,
         config: ServeConfig | None = None,
+        gateway_model_config: dict | None = None,
+        gateway_host: str = "127.0.0.1",
+        gateway_port: int = 0,
     ):
         devices = list(devices if devices is not None else jax.devices())
         self.config = config or ServeConfig()
@@ -225,12 +241,31 @@ class ServingPipeline:
             plan, variables, registry=self.registry, config=self.config
         )
         self.workers = self.dispatcher.spawn_workers(devices)
+        self.gateway = None
+        if gateway_model_config is not None:
+            from adapt_tpu.comm.remote import WorkerGateway
+
+            self.gateway = WorkerGateway(
+                self.dispatcher,
+                gateway_model_config,
+                host=gateway_host,
+                port=gateway_port,
+            )
+
+    @property
+    def gateway_port(self) -> int | None:
+        """Port joiners dial once :meth:`start` has run (None: no gateway)."""
+        return None if self.gateway is None else self.gateway.port
 
     def start(self) -> "ServingPipeline":
         self.dispatcher.start()
+        if self.gateway is not None:
+            self.gateway.start()
         return self
 
     def shutdown(self) -> None:
+        if self.gateway is not None:
+            self.gateway.stop()
         self.dispatcher.shutdown()
 
     def __enter__(self) -> "ServingPipeline":

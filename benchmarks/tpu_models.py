@@ -104,16 +104,17 @@ def main() -> int:
             timeout=900,
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         )
-        line = next(
-            (
-                ln
-                for ln in proc.stdout.splitlines()
-                if ln.strip().startswith("{")
-            ),
-            None,
-        )
-        if proc.returncode == 0 and line:
-            record = json.loads(line)
+        line = record = None
+        for ln in proc.stdout.splitlines():
+            ln = ln.strip()
+            if ln.startswith("{"):
+                try:
+                    record = json.loads(ln)
+                    line = ln
+                    break
+                except json.JSONDecodeError:
+                    continue  # stray '{'-prefixed noise; keep scanning
+        if proc.returncode == 0 and record is not None:
             if record.get("platform") == "cpu":
                 # Silent CPU fallback inside a TPU measurement: reject —
                 # a CPU number labeled as chip throughput would read as a

@@ -538,3 +538,55 @@ def test_worker_joins_running_pipeline_via_gateway(devices):
                 p.wait(timeout=10)
         gateway.stop()
         disp.shutdown()
+
+
+def test_serving_pipeline_elastic_gateway(devices):
+    """One-constructor elastic serving: ServingPipeline(gateway_model_config=...)
+    opens the join gateway; a worker process dials it and serves."""
+    from adapt_tpu.config import FaultConfig, ServeConfig
+    from adapt_tpu.graph import partition
+    from adapt_tpu.models.vit import vit_tiny
+    from adapt_tpu.runtime import ServingPipeline
+
+    g = vit_tiny()
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = g.init(jax.random.PRNGKey(0), x)
+    plan = partition(g, ["encoder_block_1"])
+    y_ref = np.asarray(g.apply(variables, x))
+
+    pipe = ServingPipeline(
+        plan,
+        variables,
+        devices=devices[:2],
+        config=ServeConfig(
+            fault=FaultConfig(
+                lease_ttl_s=1.0, heartbeat_s=0.2, startup_wait_s=10.0
+            )
+        ),
+        gateway_model_config={
+            "model": "vit_tiny",
+            "num_classes": 10,
+            "cuts": ["encoder_block_1"],
+            "input_shape": [2, 32, 32, 3],
+        },
+    )
+    proc = None
+    try:
+        pipe.start()
+        assert pipe.gateway_port
+        proc = spawn_worker_proc(
+            "--connect", f"127.0.0.1:{pipe.gateway_port}",
+            "--worker-id", "elastic-0", "--heartbeat", "0.1",
+        )
+        deadline = time.monotonic() + 30.0
+        while "elastic-0" not in pipe.registry.alive():
+            assert time.monotonic() < deadline, "joiner never registered"
+            time.sleep(0.05)
+        outs = pipe.stream([x] * 2, timeout_per_request=60.0)
+        for y in outs:
+            assert np.max(np.abs(np.asarray(y) - y_ref)) < 0.3
+    finally:
+        if proc is not None:
+            proc.terminate()
+            proc.wait(timeout=10)
+        pipe.shutdown()
