@@ -39,7 +39,9 @@ DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, sm_scale):
+def _attn_kernel(
+    q_ref, k_ref, v_ref, o_ref, *, block_k, causal, sm_scale, valid_k
+):
     q = q_ref[0].astype(jnp.float32)  # (block_q, d)
     block_q, d = q.shape
     seq_k = k_ref.shape[1]
@@ -63,12 +65,17 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, sm_scale):
             )
             * sm_scale
         )  # (block_q, block_k)
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        if valid_k != seq_k:
+            # Ragged tail: keys beyond the true sequence are zero padding
+            # (ViT's 197 = 14^2 + CLS is the canonical offender) — mask
+            # them out of the softmax like causal masks the future.
+            s = jnp.where(cols < valid_k, s, _NEG_INF)
         if causal:
             rows = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
-            )
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(rows >= cols, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -106,8 +113,10 @@ def flash_attention(
     VJP of its own, and recompute-in-backward is the flash-attention
     memory story anyway — nothing S x S is saved between the passes).
 
-    Falls back to :func:`attention_reference` when the sequence is not
-    divisible by the block sizes (tiny/odd shapes).
+    Non-block-divisible sequence lengths (ViT's 197) run the kernel via
+    internal zero-padding with key masking; the only oracle fallback left
+    is causal ragged-key cross-attention (s_q != s_k), where
+    absolute-position masking over padded interiors is ill-defined.
     """
     return _flash_vjp(q, k, v, causal, block_q, block_k)
 
@@ -145,30 +154,46 @@ def _flash_impl(
 ) -> jax.Array:
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
-    block_q = min(block_q, s_q)
-    block_k = min(block_k, s_k)
-    if s_q % block_q or s_k % block_k:
+    block_q = min(block_q, max(s_q, 8))
+    block_k = min(block_k, max(s_k, 8))
+    # Ragged sequences (ViT's 197) are zero-padded up to whole blocks;
+    # padded KEY positions are masked inside the kernel (valid_k), padded
+    # QUERY rows compute garbage that is sliced off below. Only degenerate
+    # cross-attention raggedness under causal falls back to the oracle
+    # (absolute-position masking with padded interior is ill-defined).
+    pad_q = (-s_q) % block_q
+    pad_k = (-s_k) % block_k
+    if causal and pad_k and s_q != s_k:
         return attention_reference(q, k, v, causal=causal)
+    if pad_q or pad_k:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
 
     sm_scale = 1.0 / math.sqrt(d)
-    qf = q.reshape(b * h, s_q, d)
-    kf = k.reshape(b * h, s_k, d)
-    vf = v.reshape(b * h, s_k, d)
+    sp_q, sp_k = s_q + pad_q, s_k + pad_k
+    qf = q.reshape(b * h, sp_q, d)
+    kf = k.reshape(b * h, sp_k, d)
+    vf = v.reshape(b * h, sp_k, d)
     kernel = functools.partial(
-        _attn_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale
+        _attn_kernel,
+        block_k=block_k,
+        causal=causal,
+        sm_scale=sm_scale,
+        valid_k=s_k,
     )
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, s_q // block_q),
+        grid=(b * h, sp_q // block_q),
         in_specs=[
             pl.BlockSpec(
                 (1, block_q, d), lambda bh, qi: (bh, qi, 0), memory_space=_VMEM
             ),
             pl.BlockSpec(
-                (1, s_k, d), lambda bh, qi: (bh, 0, 0), memory_space=_VMEM
+                (1, sp_k, d), lambda bh, qi: (bh, 0, 0), memory_space=_VMEM
             ),
             pl.BlockSpec(
-                (1, s_k, d), lambda bh, qi: (bh, 0, 0), memory_space=_VMEM
+                (1, sp_k, d), lambda bh, qi: (bh, 0, 0), memory_space=_VMEM
             ),
         ],
         out_specs=pl.BlockSpec(
@@ -177,7 +202,7 @@ def _flash_impl(
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
         interpret=jax.default_backend() != "tpu",
     )(qf, kf, vf)
-    return out.reshape(b, h, s_q, d)
+    return out.reshape(b, h, sp_q, d)[:, :, :s_q, :]
 
 
 _flash_vjp.defvjp(_flash_fwd, _flash_bwd)

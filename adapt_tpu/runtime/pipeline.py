@@ -254,8 +254,11 @@ class ServingPipeline:
 
     @property
     def gateway_port(self) -> int | None:
-        """Port joiners dial once :meth:`start` has run (None: no gateway)."""
-        return None if self.gateway is None else self.gateway.port
+        """Port joiners dial. None until :meth:`start` binds the gateway
+        (or when no gateway was configured) — never the 0 placeholder."""
+        if self.gateway is None or not self.gateway.port:
+            return None
+        return self.gateway.port
 
     def start(self) -> "ServingPipeline":
         self.dispatcher.start()

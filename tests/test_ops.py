@@ -138,3 +138,26 @@ def test_flash_attention_bf16(rng):
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
     )
+
+
+@pytest.mark.parametrize(
+    "b,h,s,d,causal",
+    [
+        (2, 4, 197, 64, False),  # ViT-B/16's 14^2+CLS — the ragged case
+        (1, 2, 197, 32, True),
+        (1, 1, 130, 8, True),
+    ],
+)
+def test_flash_attention_ragged_sequences(b, h, s, d, causal):
+    """Non-block-divisible sequence lengths run the Pallas path via
+    internal zero-padding + key masking (regression: they silently fell
+    back to the jnp oracle, so ViT-B/16 at 224px never used the kernel)."""
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, s, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, h, s, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d))
+    out = flash_attention(q, k, v, causal=causal)
+    ref = attention_reference(q, k, v, causal=causal)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=5e-3, atol=5e-3
+    )
