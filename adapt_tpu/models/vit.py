@@ -30,7 +30,10 @@ class MultiHeadSelfAttention(nn.Module):
 
     heads: int
     dtype: jnp.dtype = jnp.float32
-    attn_fn: Callable = staticmethod(flash_attention)
+    #: None -> ``flash_attention`` with ``prefer=attn_prefer``; a custom
+    #: callable receives plain (q, k, v) and owns its own dispatch.
+    attn_fn: Callable | None = None
+    attn_prefer: str | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -46,7 +49,10 @@ class MultiHeadSelfAttention(nn.Module):
         # -> three (b, h, s, hd) tensors for the kernel's layout.
         q, k, v = jnp.moveaxis(qkv, 2, 0)
         q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
-        o = self.attn_fn(q, k, v)
+        if self.attn_fn is None:
+            o = flash_attention(q, k, v, prefer=self.attn_prefer)
+        else:
+            o = self.attn_fn(q, k, v)
         o = jnp.swapaxes(o, 1, 2).reshape(b, s, d)
         return nn.Dense(d, dtype=self.dtype, name="out")(o)
 
@@ -91,12 +97,16 @@ class EncoderBlock(nn.Module):
     heads: int
     mlp_dim: int
     dtype: jnp.dtype = jnp.float32
+    attn_prefer: str | None = None
 
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(dtype=self.dtype)(x)
         y = MultiHeadSelfAttention(
-            heads=self.heads, dtype=self.dtype, name="attn"
+            heads=self.heads,
+            dtype=self.dtype,
+            attn_prefer=self.attn_prefer,
+            name="attn",
         )(y)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)
@@ -129,21 +139,34 @@ def vit(
     num_classes: int = 1000,
     dtype: jnp.dtype = jnp.float32,
     name: str = "vit",
+    attn_prefer: str | None = None,
 ) -> LayerGraph:
     g = LayerGraph(name)
     prev = g.add("patch_embed", PatchEmbed(patch, dim, dtype=dtype), INPUT)
     for i in range(depth):
         prev = g.add(
             f"encoder_block_{i}",
-            EncoderBlock(dim, heads, mlp_dim, dtype=dtype),
+            EncoderBlock(
+                dim, heads, mlp_dim, dtype=dtype, attn_prefer=attn_prefer
+            ),
             prev,
         )
     g.add("head", ViTHead(num_classes, dtype=dtype), prev)
     return g
 
 
-def vit_b16(num_classes: int = 1000, dtype: jnp.dtype = jnp.float32) -> LayerGraph:
-    return vit(16, 768, 12, 12, 3072, num_classes, dtype, name="vit_b16")
+def vit_b16(
+    num_classes: int = 1000,
+    dtype: jnp.dtype = jnp.float32,
+    attn_prefer: str | None = None,
+) -> LayerGraph:
+    """``attn_prefer`` forces the attention path ("pallas"/"xla"); default
+    None follows the measured dispatch in ``ops.attention`` (the A/B knob
+    behind ``benchmarks/tpu_models.py --attn``)."""
+    return vit(
+        16, 768, 12, 12, 3072, num_classes, dtype,
+        name="vit_b16", attn_prefer=attn_prefer,
+    )
 
 
 def vit_tiny(num_classes: int = 10, dtype: jnp.dtype = jnp.float32) -> LayerGraph:

@@ -38,24 +38,64 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
 
+#: Measured dispatch budget (real v5e chip, artifacts
+#: benchmarks/results/r03/{attn_crossover,attn_longseq}.json and the
+#: end-to-end ViT A/B in tpu_vit_b16_ab.json): XLA's fused attention
+#: beats the Pallas kernel while the materialized f32 score tensor
+#: (batch*heads*s_q*s_k*4 bytes) is small — end-to-end ViT-B/16 ran 1.9x
+#: faster through XLA (3,360 vs 1,781 img/s) — but score memory grows
+#: O(S^2): at 2 GiB+ it crowds out everything else in 16 GiB HBM (and at
+#: s=32k, 51.5 GiB, XLA simply OOMs) while the streaming kernel stays
+#: O(S*D). The measured crossover sits in the same region: flash already
+#: beats XLA at (1, 12, 8192) = 3 GiB scores. ``prefer=`` overrides.
+FLASH_SCORE_BYTES_BUDGET = 2 << 30
+
+#: Absolute guard on top of the byte budget: at or past this key length
+#: the kernel is used regardless of batch (a tiny-batch long sequence can
+#: sneak under the byte budget while still being the regime XLA handles
+#: worst).
+FLASH_MIN_SEQ = 32768
+
 
 def _attn_kernel(
-    q_ref, k_ref, v_ref, o_ref, *, block_k, causal, sm_scale, valid_k
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    block_k,
+    num_kv,
+    causal,
+    sm_scale,
+    valid_k,
 ):
-    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
-    block_q, d = q.shape
-    seq_k = k_ref.shape[1]
-    num_kv = seq_k // block_k
+    """Grid = (batch*heads, q_blocks, k_blocks); the k dimension is the
+    innermost (sequential) axis, so only ONE (block_q, d) q tile and ONE
+    (block_k, d) K/V tile are VMEM-resident at a time — K/V stream from
+    HBM block by block and the online-softmax state (running max, denom,
+    accumulator) persists across k steps in VMEM scratch. Per-program
+    VMEM is O(block_q * (d + block_k)) regardless of sequence length,
+    which is what lets the kernel run 32k+ sequences that OOM both the
+    naive full-K/V-in-VMEM layout (scoped-vmem) and XLA's materialized
+    S x S scores (HBM) — measured in
+    benchmarks/results/r03/attn_longseq.json."""
+    j = pl.program_id(2)
+    block_q = q_ref.shape[1]
     q_start = pl.program_id(1) * block_q
 
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
         s = (
             jax.lax.dot_general(
                 q,
@@ -68,7 +108,7 @@ def _attn_kernel(
         cols = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        if valid_k != seq_k:
+        if valid_k != num_kv * block_k:
             # Ragged tail: keys beyond the true sequence are zero padding
             # (ViT's 197 = 14^2 + CLS is the canonical offender) — mask
             # them out of the softmax like causal masks the future.
@@ -78,24 +118,28 @@ def _attn_kernel(
                 jnp.int32, (block_q, block_k), 0
             )
             s = jnp.where(rows >= cols, s, _NEG_INF)
+        m = m_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
 
-    # Causal: k blocks strictly after this q block contribute nothing.
     if causal:
-        upper = jnp.minimum(
-            (q_start + block_q + block_k - 1) // block_k, num_kv
-        )
+        # K blocks strictly after this q block contribute nothing — skip
+        # their compute entirely (the DMA still lands, the MXU stays idle).
+        pl.when(j * block_k <= q_start + block_q - 1)(_step)
     else:
-        upper = num_kv
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        _step()
+
+    @pl.when(j == num_kv - 1)
+    def _emit():
+        o_ref[0] = (
+            acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+        ).astype(o_ref.dtype)
 
 
 def flash_attention(
@@ -105,19 +149,49 @@ def flash_attention(
     causal: bool = False,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    prefer: str | None = None,
 ) -> jax.Array:
     """Fused attention over (batch, heads, seq, head_dim) tensors.
 
-    Differentiable: the forward pass is the Pallas kernel; the backward
-    pass recomputes scores with the jnp oracle (pallas_call defines no
-    VJP of its own, and recompute-in-backward is the flash-attention
-    memory story anyway — nothing S x S is saved between the passes).
+    Dispatch is perf-measured, not dogmatic: while the materialized
+    f32 score tensor stays under ``FLASH_SCORE_BYTES_BUDGET`` the XLA
+    path wins on the real chip (end-to-end ViT-B/16: 1.9x — artifacts
+    ``benchmarks/results/r03/attn_crossover.json`` / ``attn_longseq``);
+    past it the streaming Pallas kernel takes over — O(S*D) HBM and
+    O(block) VMEM, serving 32k+ sequences where XLA's scores exceed HBM
+    outright. ``prefer="pallas"`` or ``"xla"`` forces a path (tests, the
+    SP block compute, and the sweeps themselves use this).
+
+    Differentiable, with a caveat at extreme lengths: the Pallas forward
+    pairs with a backward that recomputes scores via the jnp oracle
+    (pallas_call defines no VJP of its own), and that recompute
+    materializes the S x S score tensor — so gradients share XLA's
+    memory ceiling (~16k keys at ViT width on one v5e chip). Past the
+    budget the Pallas path is effectively forward/inference-only; a
+    streaming Pallas backward is the known follow-up if long-context
+    *training* on one chip is ever needed (ring attention covers it
+    today by sharding S over the mesh).
 
     Non-block-divisible sequence lengths (ViT's 197) run the kernel via
     internal zero-padding with key masking; the only oracle fallback left
     is causal ragged-key cross-attention (s_q != s_k), where
     absolute-position masking over padded interiors is ill-defined.
     """
+    if prefer is None:
+        b, h, s_q, _ = q.shape
+        score_bytes = b * h * s_q * k.shape[2] * 4
+        prefer = (
+            "pallas"
+            if score_bytes > FLASH_SCORE_BYTES_BUDGET
+            or k.shape[2] >= FLASH_MIN_SEQ
+            else "xla"
+        )
+    elif prefer not in ("pallas", "xla"):
+        raise ValueError(
+            f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
+        )
+    if prefer == "xla":
+        return attention_reference(q, k, v, causal=causal)
     return _flash_vjp(q, k, v, causal, block_q, block_k)
 
 
@@ -152,6 +226,8 @@ def _flash_impl(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
 ) -> jax.Array:
+    if pltpu is None:  # pragma: no cover — jax builds without pallas-tpu
+        return attention_reference(q, k, v, causal=causal)
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
     block_q = min(block_q, max(s_q, 8))
@@ -172,35 +248,62 @@ def _flash_impl(
 
     sm_scale = 1.0 / math.sqrt(d)
     sp_q, sp_k = s_q + pad_q, s_k + pad_k
+    num_kv = sp_k // block_k
     qf = q.reshape(b * h, sp_q, d)
     kf = k.reshape(b * h, sp_k, d)
     vf = v.reshape(b * h, sp_k, d)
     kernel = functools.partial(
         _attn_kernel,
         block_k=block_k,
+        num_kv=num_kv,
         causal=causal,
         sm_scale=sm_scale,
         valid_k=s_k,
     )
+    on_tpu = jax.default_backend() == "tpu"
+    scratch = [
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, d), jnp.float32),
+    ]
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, sp_q // block_q),
+        # K/V stream one block per innermost grid step; scratch carries
+        # the online-softmax state across them (TPU grids iterate
+        # sequentially, innermost-fastest, so the state is coherent).
+        grid=(b * h, sp_q // block_q, num_kv),
         in_specs=[
             pl.BlockSpec(
-                (1, block_q, d), lambda bh, qi: (bh, qi, 0), memory_space=_VMEM
+                (1, block_q, d),
+                lambda bh, qi, kj: (bh, qi, 0),
+                memory_space=_VMEM,
             ),
             pl.BlockSpec(
-                (1, sp_k, d), lambda bh, qi: (bh, 0, 0), memory_space=_VMEM
+                (1, block_k, d),
+                lambda bh, qi, kj: (bh, kj, 0),
+                memory_space=_VMEM,
             ),
             pl.BlockSpec(
-                (1, sp_k, d), lambda bh, qi: (bh, 0, 0), memory_space=_VMEM
+                (1, block_k, d),
+                lambda bh, qi, kj: (bh, kj, 0),
+                memory_space=_VMEM,
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, block_q, d), lambda bh, qi: (bh, qi, 0), memory_space=_VMEM
+            (1, block_q, d),
+            lambda bh, qi, kj: (bh, qi, 0),
+            memory_space=_VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
-        interpret=jax.default_backend() != "tpu",
+        scratch_shapes=scratch,
+        compiler_params=(
+            pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            )
+            if on_tpu and pltpu is not None
+            else None
+        ),
+        interpret=not on_tpu,
     )(qf, kf, vf)
     return out.reshape(b, h, sp_q, d)[:, :, :s_q, :]
 

@@ -37,7 +37,11 @@ def distinct_inputs(key, shape, n: int):
     ]
 
 
-def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
+def emit(
+    metric: str, value: float, unit: str, vs_baseline: float, **extra
+) -> None:
+    """The one-JSON-line contract; ``extra`` fields (platform, device,
+    trial timings, notes) append after the four required keys."""
     print(
         json.dumps(
             {
@@ -45,8 +49,10 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
                 "value": round(value, 4),
                 "unit": unit,
                 "vs_baseline": round(vs_baseline, 4),
+                **extra,
             }
-        )
+        ),
+        flush=True,
     )
 
 

@@ -67,6 +67,12 @@ def main() -> None:
         req_s,
         "req/s",
         req_s / A100_IMAGES_PER_SEC,
+        platform=jax.devices()[0].platform,
+        device=str(jax.devices()[0]),
+        batch=1,
+        iters=ITERS,
+        trials=TRIALS,
+        trial_seconds=[round(t, 4) for t in times],
     )
 
 

@@ -29,7 +29,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks.common import int_flag  # noqa: E402  (imports no JAX)
+from benchmarks.common import int_flag, str_flag  # noqa: E402  (imports no JAX)
 
 TPU_V5E_PEAK_FLOPS = 197e12  # bf16
 
@@ -41,7 +41,9 @@ MODELS = {
 }
 
 
-def _child(model: str, batch: int, iters: int, trials: int) -> None:
+def _child(
+    model: str, batch: int, iters: int, trials: int, attn: str | None
+) -> None:
     import jax
     import jax.numpy as jnp
 
@@ -50,13 +52,15 @@ def _child(model: str, batch: int, iters: int, trials: int) -> None:
 
     _, flops, a100 = MODELS[model]
     factory, (h, w, c) = MODEL_REGISTRY[model]
-    graph = factory(num_classes=1000, dtype=jnp.bfloat16)
+    kwargs = {"attn_prefer": attn} if attn else {}
+    graph = factory(num_classes=1000, dtype=jnp.bfloat16, **kwargs)
     x0 = jax.random.normal(
         jax.random.PRNGKey(0), (batch, h, w, c), jnp.float32
     )
     images_per_sec, times = measure_scan_throughput(graph, x0, iters, trials)
     record = {
-        "metric": f"{model}_bs{batch}_images_per_sec_per_chip",
+        "metric": f"{model}_bs{batch}_images_per_sec_per_chip"
+        + (f"_attn_{attn}" if attn else ""),
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / a100, 4),
@@ -89,13 +93,25 @@ def main() -> int:
     batch = int_flag(sys.argv, "--batch", default_batch)
     iters = int_flag(sys.argv, "--iters", 50)
     trials = int_flag(sys.argv, "--trials", 5)
+    # End-to-end attention A/B knob (vit only): force "pallas" or "xla";
+    # default "" follows ops.attention's measured dispatch.
+    attn = str_flag(sys.argv, "--attn", "", choices=("", "pallas", "xla"))
+    if attn and model != "vit_b16":
+        print(json.dumps({"metric": f"{model}_bs{batch}_images_per_sec_per_chip",
+                          "value": 0.0, "unit": "images/sec",
+                          "vs_baseline": 0.0,
+                          "error": "--attn applies only to vit_b16 "
+                                   "(the other models have no attention)"}))
+        return 0
     if "--child" in sys.argv:
-        _child(model, batch, iters, trials)
+        _child(model, batch, iters, trials, attn or None)
         return 0
 
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            "--model", model, "--batch", str(batch),
            "--iters", str(iters), "--trials", str(trials)]
+    if attn:
+        cmd += ["--attn", attn]
     try:
         proc = subprocess.run(
             cmd,

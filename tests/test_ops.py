@@ -92,7 +92,9 @@ def test_flash_attention_matches_reference(rng, causal):
     q = jax.random.normal(kq, (b, h, s, d))
     k = jax.random.normal(kk, (b, h, s, d))
     v = jax.random.normal(kv, (b, h, s, d))
-    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+    out = flash_attention(
+        q, k, v, causal=causal, block_q=128, block_k=128, prefer="pallas"
+    )
     ref = attention_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
@@ -105,7 +107,9 @@ def test_flash_attention_small_blocks(rng):
     q = jax.random.normal(kq, (b, h, s, d))
     k = jax.random.normal(kk, (b, h, s, d))
     v = jax.random.normal(kv, (b, h, s, d))
-    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=64)
+    out = flash_attention(
+        q, k, v, causal=True, block_q=32, block_k=64, prefer="pallas"
+    )
     ref = attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
@@ -119,7 +123,7 @@ def test_flash_attention_indivisible_falls_back(rng):
     q = jax.random.normal(kq, (b, h, s, d))
     k = jax.random.normal(kk, (b, h, s, d))
     v = jax.random.normal(kv, (b, h, s, d))
-    out = flash_attention(q, k, v, causal=False)
+    out = flash_attention(q, k, v, causal=False, prefer="pallas")
     ref = attention_reference(q, k, v, causal=False)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
@@ -132,7 +136,7 @@ def test_flash_attention_bf16(rng):
     q = jax.random.normal(kq, (b, h, s, d)).astype(jnp.bfloat16)
     k = jax.random.normal(kk, (b, h, s, d)).astype(jnp.bfloat16)
     v = jax.random.normal(kv, (b, h, s, d)).astype(jnp.bfloat16)
-    out = flash_attention(q, k, v)
+    out = flash_attention(q, k, v, prefer="pallas")
     assert out.dtype == jnp.bfloat16
     ref = attention_reference(q, k, v)
     np.testing.assert_allclose(
@@ -155,9 +159,43 @@ def test_flash_attention_ragged_sequences(b, h, s, d, causal):
     q = jax.random.normal(jax.random.PRNGKey(0), (b, h, s, d))
     k = jax.random.normal(jax.random.PRNGKey(1), (b, h, s, d))
     v = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d))
-    out = flash_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, prefer="pallas")
     ref = attention_reference(q, k, v, causal=causal)
     assert out.shape == ref.shape
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=5e-3, atol=5e-3
     )
+
+def test_flash_attention_dispatch_heuristic(rng, monkeypatch):
+    """Default dispatch is measured, not dogmatic: small score tensors
+    route to the XLA path (which beat the kernel 1.9x end-to-end at ViT
+    shapes on the real chip, benchmarks/results/r03/), score tensors past
+    the HBM budget stream through the Pallas kernel (XLA OOMs outright at
+    32k, attn_longseq.json). Paths are stubbed — this tests routing, not
+    the kernels (covered above)."""
+    import adapt_tpu.ops.attention as A
+
+    calls = []
+    monkeypatch.setattr(
+        A, "_flash_vjp", lambda q, *a: calls.append("pallas") or q
+    )
+    monkeypatch.setattr(
+        A, "attention_reference", lambda q, *a, **kw: calls.append("xla") or q
+    )
+    short = jax.random.normal(rng, (1, 2, 128, 32))
+    A.flash_attention(short, short, short)
+    assert calls == ["xla"]
+
+    calls.clear()
+    # (1, 1, 32768, 32): scores = 32768^2 * 4B = 4 GiB > the 2 GiB budget.
+    long = jax.ShapeDtypeStruct((1, 1, 32768, 32), jnp.bfloat16)
+    jax.eval_shape(lambda t: A.flash_attention(t, t, t), long)
+    assert calls == ["pallas"]
+
+    calls.clear()
+    # prefer= overrides the heuristic both ways.
+    A.flash_attention(short, short, short, prefer="pallas")
+    jax.eval_shape(
+        lambda t: A.flash_attention(t, t, t, prefer="xla"), long
+    )
+    assert calls == ["pallas", "xla"]

@@ -187,13 +187,23 @@ def test_ulysses_rejects_indivisible_heads(sp_mesh):
 
 
 def test_ulysses_with_flash_block(sp_mesh):
+    import functools
+
     from adapt_tpu.ops import flash_attention
     from adapt_tpu.parallel.ulysses import ulysses_attention
 
     b, h, s, d = 1, 8, 128, 16
     q = jax.random.normal(jax.random.PRNGKey(12), (b, h, s, d))
     out = ulysses_attention(
-        q, q, q, sp_mesh, axis="sp", causal=True, attn_fn=flash_attention
+        q,
+        q,
+        q,
+        sp_mesh,
+        axis="sp",
+        causal=True,
+        # Pin the Pallas path: the measured dispatch would route these
+        # small per-device shards to XLA (ops.attention.FLASH_MIN_SEQ).
+        attn_fn=functools.partial(flash_attention, prefer="pallas"),
     )
     ref = full_attention(q, q, q, causal=True)
     np.testing.assert_allclose(
