@@ -8,9 +8,12 @@ score matrix out of HBM entirely — scores live in VMEM one (block_q,
 block_k) tile at a time with online-softmax accumulation, so memory is
 O(S * D) instead of O(S^2) and the matmuls stay on the MXU.
 
-Grid: (batch*heads, S/block_q). Each program holds one q block plus that
-(batch, head)'s full K/V in VMEM and loops over k blocks with running
-(max, denom, acc) — the standard online softmax recurrence.
+Grid: (batch*heads, S/block_q, S/block_k), k innermost. Each program
+holds ONE q tile and ONE K/V tile in VMEM; K/V stream from HBM block by
+block while the running (max, denom, acc) online-softmax state persists
+in VMEM scratch — O(block) VMEM at any sequence length. The backward is
+the same discipline in reverse: two streaming passes (dQ, then dK/dV)
+recompute score blocks against the saved row logsumexp.
 
 Off-TPU the kernel runs through the Pallas interpreter, so tests on the
 virtual CPU mesh exercise the same code path; ``attention_reference`` is
@@ -62,6 +65,7 @@ def _attn_kernel(
     k_ref,
     v_ref,
     o_ref,
+    lse_ref,
     m_scr,
     l_scr,
     acc_scr,
@@ -140,6 +144,17 @@ def _attn_kernel(
         o_ref[0] = (
             acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
         ).astype(o_ref.dtype)
+        # Per-row logsumexp — the O(S) softmax residual the streaming
+        # backward recomputes scores against (saving it is what lets the
+        # backward stay O(S*D) instead of keeping S x S probabilities).
+        # Stored 8-row-broadcast: TPU lowering needs the last two block
+        # dims divisible by (8, 128), so the row vector rides in a
+        # (1, 8, block_q) tile (row 0 is read back; x8 on an O(S) tensor
+        # is noise next to the O(S*D) tensors).
+        lse = (
+            m_scr[...] + jnp.log(jnp.maximum(l_scr[...], 1e-30))
+        ).reshape(1, 1, -1)
+        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
 
 
 def flash_attention(
@@ -162,15 +177,12 @@ def flash_attention(
     outright. ``prefer="pallas"`` or ``"xla"`` forces a path (tests, the
     SP block compute, and the sweeps themselves use this).
 
-    Differentiable, with a caveat at extreme lengths: the Pallas forward
-    pairs with a backward that recomputes scores via the jnp oracle
-    (pallas_call defines no VJP of its own), and that recompute
-    materializes the S x S score tensor — so gradients share XLA's
-    memory ceiling (~16k keys at ViT width on one v5e chip). Past the
-    budget the Pallas path is effectively forward/inference-only; a
-    streaming Pallas backward is the known follow-up if long-context
-    *training* on one chip is ever needed (ring attention covers it
-    today by sharding S over the mesh).
+    Differentiable at every length: sub-budget shapes recompute the
+    backward through the jnp oracle (one materialized pass — fastest
+    where scores fit), super-budget shapes run the streaming Pallas
+    backward (two passes, dQ then dK/dV, recomputing score blocks
+    against the saved row logsumexp) — O(S*D) HBM either direction, so
+    long-context gradients survive where a materialized recompute OOMs.
 
     Non-block-divisible sequence lengths (ViT's 197) run the kernel via
     internal zero-padding with key masking; the only oracle fallback left
@@ -200,23 +212,55 @@ def _flash_vjp(q, k, v, causal, block_q, block_k):
     return _flash_impl(q, k, v, causal, block_q, block_k)
 
 
+def _bwd_streams(q_shape, k_shape, causal, block_q, block_k) -> bool:
+    """Static decision (shapes only) shared by fwd and bwd: does the
+    backward run the streaming Pallas passes? False -> one materialized
+    jnp-oracle recompute, which is faster wherever scores fit and is the
+    only option off pallas-tpu or on the causal ragged-cross-attention
+    shape the forward itself oracles."""
+    b, h, s_q, _ = q_shape
+    s_k = k_shape[2]
+    if pltpu is None:  # pragma: no cover — jax builds without pallas-tpu
+        return False
+    score_bytes = b * h * s_q * s_k * 4
+    small = score_bytes <= FLASH_SCORE_BYTES_BUDGET and s_k < FLASH_MIN_SEQ
+    pad_k = (-s_k) % min(block_k, max(s_k, 8))
+    return not (small or (causal and pad_k and s_q != s_k))
+
+
 def _flash_fwd(q, k, v, causal, block_q, block_k):
-    return _flash_impl(q, k, v, causal, block_q, block_k), (q, k, v)
+    # Save the O(S) logsumexp (and keep `out` alive) only when the
+    # backward will actually stream; the oracle branch re-derives
+    # everything from (q, k, v).
+    if _bwd_streams(q.shape, k.shape, causal, block_q, block_k):
+        out, lse = _flash_impl(
+            q, k, v, causal, block_q, block_k, with_lse=True
+        )
+        return out, (q, k, v, out, lse)
+    out = _flash_impl(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v, None, None)
 
 
 def _flash_bwd(causal, block_q, block_k, residuals, do):
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal),
-        q,
-        k,
-        v,
+    q, k, v, out, lse = residuals
+    if out is None:  # fwd decided on the materialized-recompute branch
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: attention_reference(
+                q_, k_, v_, causal=causal
+            ),
+            q,
+            k,
+            v,
+        )
+        return vjp(do)
+    return _flash_bwd_impl(
+        q, k, v, out, lse, do,
+        causal=causal, block_q=block_q, block_k=block_k,
     )
-    return vjp(do)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k")
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "with_lse")
 )
 def _flash_impl(
     q: jax.Array,
@@ -225,9 +269,11 @@ def _flash_impl(
     causal: bool = False,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
-) -> jax.Array:
+    with_lse: bool = False,
+):
     if pltpu is None:  # pragma: no cover — jax builds without pallas-tpu
-        return attention_reference(q, k, v, causal=causal)
+        out = attention_reference(q, k, v, causal=causal)
+        return (out, _lse_reference(q, k, causal)) if with_lse else out
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
     block_q = min(block_q, max(s_q, 8))
@@ -240,7 +286,8 @@ def _flash_impl(
     pad_q = (-s_q) % block_q
     pad_k = (-s_k) % block_k
     if causal and pad_k and s_q != s_k:
-        return attention_reference(q, k, v, causal=causal)
+        out = attention_reference(q, k, v, causal=causal)
+        return (out, _lse_reference(q, k, causal)) if with_lse else out
     if pad_q or pad_k:
         q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
         k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
@@ -266,7 +313,7 @@ def _flash_impl(
         pltpu.VMEM((block_q, 1), jnp.float32),
         pltpu.VMEM((block_q, d), jnp.float32),
     ]
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         # K/V stream one block per innermost grid step; scratch carries
         # the online-softmax state across them (TPU grids iterate
@@ -289,12 +336,22 @@ def _flash_impl(
                 memory_space=_VMEM,
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, d),
-            lambda bh, qi, kj: (bh, qi, 0),
-            memory_space=_VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec(
+                (1, block_q, d),
+                lambda bh, qi, kj: (bh, qi, 0),
+                memory_space=_VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 8, block_q),
+                lambda bh, qi, kj: (bh, 0, qi),
+                memory_space=_VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qf.shape, q.dtype),
+            jax.ShapeDtypeStruct((b * h, 8, sp_q), jnp.float32),
+        ],
         scratch_shapes=scratch,
         compiler_params=(
             pltpu.CompilerParams(
@@ -305,7 +362,305 @@ def _flash_impl(
         ),
         interpret=not on_tpu,
     )(qf, kf, vf)
-    return out.reshape(b, h, sp_q, d)[:, :, :s_q, :]
+    out = out.reshape(b, h, sp_q, d)[:, :, :s_q, :]
+    if not with_lse:
+        return out
+    return out, lse[:, 0, :].reshape(b, h, sp_q)[:, :, :s_q]
+
+
+def _lse_reference(q: jax.Array, k: jax.Array, causal: bool) -> jax.Array:
+    """Row logsumexp of the scaled (masked) scores — oracle-path residual
+    matching the kernel's ``lse`` output."""
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / math.sqrt(d)
+    if causal:
+        s_q, s_k = s.shape[-2:]
+        s = jnp.where(jnp.tril(jnp.ones((s_q, s_k), bool)), s, _NEG_INF)
+    return jax.scipy.special.logsumexp(s, axis=-1)
+
+
+def _bwd_dq_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dq_ref,
+    dq_scr,
+    *,
+    block_k,
+    num_kv,
+    causal,
+    sm_scale,
+    valid_k,
+):
+    """dQ pass: grid (bh, q_blocks, k_blocks), K/V streaming innermost;
+    dq accumulates in VMEM scratch. Scores recompute blockwise against
+    the saved row logsumexp, so nothing S x S ever exists."""
+    j = pl.program_id(2)
+    block_q = q_ref.shape[1]
+    q_start = pl.program_id(1) * block_q
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0:1, :].T  # (block_q, 1); rows 1-7 are broadcast
+        delta = delta_ref[0, 0:1, :].T
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * sm_scale
+        )
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        if valid_k != num_kv * block_k:
+            s = jnp.where(cols < valid_k, s, _NEG_INF)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(j * block_k <= q_start + block_q - 1)(_step)
+    else:
+        _step()
+
+    @pl.when(j == num_kv - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dk_ref,
+    dv_ref,
+    dk_scr,
+    dv_scr,
+    *,
+    block_q,
+    num_q,
+    causal,
+    sm_scale,
+    valid_k,
+    sp_k,
+):
+    """dK/dV pass: grid (bh, k_blocks, q_blocks), Q/dO streaming
+    innermost; dk/dv accumulate in VMEM scratch."""
+    i = pl.program_id(2)
+    block_k = k_ref.shape[1]
+    k_start = pl.program_id(1) * block_k
+    q_start = i * block_q
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0:1, :].T  # (block_q, 1); rows 1-7 are broadcast
+        delta = delta_ref[0, 0:1, :].T
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * sm_scale
+        )  # (block_q, block_k)
+        cols = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        if valid_k != sp_k:
+            s = jnp.where(cols < valid_k, s, _NEG_INF)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * sm_scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # Q blocks entirely before this K block see none of it.
+        pl.when(q_start + block_q - 1 >= k_start)(_step)
+    else:
+        _step()
+
+    @pl.when(i == num_q - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k")
+)
+def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k):
+    """Streaming flash backward: two Pallas passes (dQ, then dK/dV), each
+    recomputing score blocks against the saved logsumexp — O(S*D) HBM
+    and O(block) VMEM like the forward, so gradients survive sequence
+    lengths whose materialized S x S recompute OOMs
+    (benchmarks/results/r03/attn_longseq.json documents the forward-side
+    wall; this is the backward-side counterpart)."""
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    block_q = min(block_q, max(s_q, 8))
+    block_k = min(block_k, max(s_k, 8))
+    pad_q = (-s_q) % block_q
+    pad_k = (-s_k) % block_k
+    # delta_i = rowsum(dO_i * O_i): the only extra residual the backward
+    # needs, O(S) — computed once outside the kernels.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+        do = jnp.pad(do, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+        # Padded rows: zero q/do/delta make every contribution vanish;
+        # lse=0 keeps exp(s - lse) finite (s is 0 there, p = 1, x 0 = 0).
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q)))
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+
+    sm_scale = 1.0 / math.sqrt(d)
+    sp_q, sp_k = s_q + pad_q, s_k + pad_k
+    num_q, num_kv = sp_q // block_q, sp_k // block_k
+    qf = q.reshape(b * h, sp_q, d)
+    kf = k.reshape(b * h, sp_k, d)
+    vf = v.reshape(b * h, sp_k, d)
+    dof = do.reshape(b * h, sp_q, d)
+    # 8-row broadcast (TPU block-shape rule; see the forward's lse note).
+    lsef = jnp.broadcast_to(
+        lse.reshape(b * h, 1, sp_q), (b * h, 8, sp_q)
+    )
+    deltaf = jnp.broadcast_to(
+        delta.reshape(b * h, 1, sp_q), (b * h, 8, sp_q)
+    )
+    on_tpu = jax.default_backend() == "tpu"
+    params = (
+        pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+        if on_tpu
+        else None
+    )
+    q_spec = pl.BlockSpec(
+        (1, block_q, d), lambda bh, a, b_: (bh, a, 0), memory_space=_VMEM
+    )
+    row_spec = pl.BlockSpec(
+        (1, 8, block_q), lambda bh, a, b_: (bh, 0, a), memory_space=_VMEM
+    )
+    kv_spec_dq = pl.BlockSpec(
+        (1, block_k, d), lambda bh, a, b_: (bh, b_, 0), memory_space=_VMEM
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel,
+            block_k=block_k,
+            num_kv=num_kv,
+            causal=causal,
+            sm_scale=sm_scale,
+            valid_k=s_k,
+        ),
+        grid=(b * h, num_q, num_kv),
+        in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=params,
+        interpret=not on_tpu,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    q_spec_kv = pl.BlockSpec(
+        (1, block_q, d), lambda bh, a, b_: (bh, b_, 0), memory_space=_VMEM
+    )
+    row_spec_kv = pl.BlockSpec(
+        (1, 8, block_q), lambda bh, a, b_: (bh, 0, b_), memory_space=_VMEM
+    )
+    kv_spec = pl.BlockSpec(
+        (1, block_k, d), lambda bh, a, b_: (bh, a, 0), memory_space=_VMEM
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel,
+            block_q=block_q,
+            num_q=num_q,
+            causal=causal,
+            sm_scale=sm_scale,
+            valid_k=s_k,
+            sp_k=sp_k,
+        ),
+        grid=(b * h, num_kv, num_q),
+        in_specs=[
+            q_spec_kv,
+            kv_spec,
+            kv_spec,
+            q_spec_kv,
+            row_spec_kv,
+            row_spec_kv,
+        ],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(kf.shape, k.dtype),
+            jax.ShapeDtypeStruct(vf.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=params,
+        interpret=not on_tpu,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    dq = dq.reshape(b, h, sp_q, d)[:, :, :s_q, :]
+    dk = dk.reshape(b, h, sp_k, d)[:, :, :s_k, :]
+    dv = dv.reshape(b, h, sp_k, d)[:, :, :s_k, :]
+    return dq, dk, dv
 
 
 _flash_vjp.defvjp(_flash_fwd, _flash_bwd)
