@@ -60,6 +60,30 @@ FLASH_SCORE_BYTES_BUDGET = 2 << 30
 FLASH_MIN_SEQ = 32768
 
 
+def scores_over_budget(q_shape, k_shape) -> bool:
+    """THE dispatch predicate, shared by forward dispatch, the backward
+    branch choice, and ring attention's block_impl="auto" — one place to
+    retune so the three can't drift apart. True -> the materialized f32
+    score tensor is past the measured budget (or the absolute length
+    guard) and the streaming kernel is the right path."""
+    b, h, s_q, _ = q_shape
+    s_k = k_shape[2]
+    return (
+        b * h * s_q * s_k * 4 > FLASH_SCORE_BYTES_BUDGET
+        or s_k >= FLASH_MIN_SEQ
+    )
+
+
+def _oracle_shape(q_shape, k_shape, causal, block_k) -> bool:
+    """The one shape class the kernel itself refuses (mirrors
+    ``_flash_impl``'s fallback): causal ragged-key cross-attention,
+    where absolute-position masking over padded interiors is
+    ill-defined."""
+    s_q, s_k = q_shape[2], k_shape[2]
+    bk = min(block_k, max(s_k, 8))
+    return bool(causal and ((-s_k) % bk) and s_q != s_k)
+
+
 def _attn_kernel(
     q_ref,
     k_ref,
@@ -190,14 +214,7 @@ def flash_attention(
     absolute-position masking over padded interiors is ill-defined.
     """
     if prefer is None:
-        b, h, s_q, _ = q.shape
-        score_bytes = b * h * s_q * k.shape[2] * 4
-        prefer = (
-            "pallas"
-            if score_bytes > FLASH_SCORE_BYTES_BUDGET
-            or k.shape[2] >= FLASH_MIN_SEQ
-            else "xla"
-        )
+        prefer = "pallas" if scores_over_budget(q.shape, k.shape) else "xla"
     elif prefer not in ("pallas", "xla"):
         raise ValueError(
             f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
@@ -212,20 +229,48 @@ def _flash_vjp(q, k, v, causal, block_q, block_k):
     return _flash_impl(q, k, v, causal, block_q, block_k)
 
 
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> tuple[jax.Array, jax.Array]:
+    """Streaming-kernel attention returning ``(out, lse)`` where ``lse``
+    is the per-row logsumexp of the scaled scores, shape (b, h, s_q),
+    f32. The lse is what lets partial attention results merge exactly:
+    given per-key-block ``(o_j, lse_j)``, the blockwise combine
+
+        m = max(lse_a, lse_b)
+        o = (o_a * exp(lse_a - m) + o_b * exp(lse_b - m))
+            / (exp(lse_a - m) + exp(lse_b - m))
+        lse = m + log(exp(lse_a - m) + exp(lse_b - m))
+
+    reproduces full-softmax attention — the contract ring attention's
+    flash block path builds on (``parallel/ring_attention.py``).
+
+    Forward-only: this entry point bypasses the custom-VJP wrapper (an
+    lse output would need its own streaming VJP); differentiating
+    through it fails at the pallas_call. Use :func:`flash_attention` for
+    training paths.
+    """
+    return _flash_impl(
+        q, k, v, causal, block_q, block_k, with_lse=True
+    )
+
+
 def _bwd_streams(q_shape, k_shape, causal, block_q, block_k) -> bool:
     """Static decision (shapes only) shared by fwd and bwd: does the
     backward run the streaming Pallas passes? False -> one materialized
     jnp-oracle recompute, which is faster wherever scores fit and is the
     only option off pallas-tpu or on the causal ragged-cross-attention
     shape the forward itself oracles."""
-    b, h, s_q, _ = q_shape
-    s_k = k_shape[2]
     if pltpu is None:  # pragma: no cover — jax builds without pallas-tpu
         return False
-    score_bytes = b * h * s_q * s_k * 4
-    small = score_bytes <= FLASH_SCORE_BYTES_BUDGET and s_k < FLASH_MIN_SEQ
-    pad_k = (-s_k) % min(block_k, max(s_k, 8))
-    return not (small or (causal and pad_k and s_q != s_k))
+    return scores_over_budget(q_shape, k_shape) and not _oracle_shape(
+        q_shape, k_shape, causal, block_k
+    )
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k):
@@ -272,8 +317,11 @@ def _flash_impl(
     with_lse: bool = False,
 ):
     if pltpu is None:  # pragma: no cover — jax builds without pallas-tpu
-        out = attention_reference(q, k, v, causal=causal)
-        return (out, _lse_reference(q, k, causal)) if with_lse else out
+        return (
+            _reference_with_lse(q, k, v, causal)
+            if with_lse
+            else attention_reference(q, k, v, causal=causal)
+        )
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
     block_q = min(block_q, max(s_q, 8))
@@ -286,8 +334,11 @@ def _flash_impl(
     pad_q = (-s_q) % block_q
     pad_k = (-s_k) % block_k
     if causal and pad_k and s_q != s_k:
-        out = attention_reference(q, k, v, causal=causal)
-        return (out, _lse_reference(q, k, causal)) if with_lse else out
+        return (
+            _reference_with_lse(q, k, v, causal)
+            if with_lse
+            else attention_reference(q, k, v, causal=causal)
+        )
     if pad_q or pad_k:
         q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
         k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
@@ -368,9 +419,12 @@ def _flash_impl(
     return out, lse[:, 0, :].reshape(b, h, sp_q)[:, :, :s_q]
 
 
-def _lse_reference(q: jax.Array, k: jax.Array, causal: bool) -> jax.Array:
-    """Row logsumexp of the scaled (masked) scores — oracle-path residual
-    matching the kernel's ``lse`` output."""
+def _reference_with_lse(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool
+) -> tuple[jax.Array, jax.Array]:
+    """Oracle-path ``(out, lse)`` computing the score matrix ONCE (the
+    fallback exists because scores are expensive to materialize —
+    don't pay for them twice)."""
     d = q.shape[-1]
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
@@ -378,7 +432,12 @@ def _lse_reference(q: jax.Array, k: jax.Array, causal: bool) -> jax.Array:
     if causal:
         s_q, s_k = s.shape[-2:]
         s = jnp.where(jnp.tril(jnp.ones((s_q, s_k), bool)), s, _NEG_INF)
-    return jax.scipy.special.logsumexp(s, axis=-1)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(
+        q.dtype
+    )
+    return out, lse
 
 
 def _bwd_dq_kernel(

@@ -50,12 +50,34 @@ def ring_attention(
     mesh: Mesh,
     axis: str = "sp",
     causal: bool = False,
+    block_impl: str = "jnp",
 ) -> jax.Array:
     """Sequence-parallel attention over ``axis``.
 
     q, k, v: [B, H, S, D] with S divisible by the axis size; inputs/outputs
     are sharded on the S dimension over ``axis`` (pass global arrays under
     jit; GSPMD splits them per the shard_map specs).
+
+    ``block_impl`` picks the per-device block compute:
+
+    - ``"jnp"`` (default) — the fused-by-XLA online-softmax update
+      below. Fully differentiable (training and serving); materializes
+      one (S/P, S/P) score block per ring step, which is fine until
+      shards are themselves long.
+    - ``"flash"`` — the streaming Pallas kernel via
+      :func:`adapt_tpu.ops.attention.flash_attention_with_lse`; per-step
+      results merge by logsumexp, so per-device memory stays O(S/P * D)
+      even at 32k-token *shards* (the regime where a materialized score
+      block is itself gigabytes — same wall as
+      ``benchmarks/results/r03/attn_longseq.json``). FORWARD-ONLY: the
+      lse entry point has no VJP, so ``jax.grad`` through it fails
+      loudly at the pallas_call — an explicit serving-path opt-in, which
+      is why it is not the default.
+    - ``"auto"`` — ``"flash"`` exactly when a single score block busts
+      ``FLASH_SCORE_BYTES_BUDGET`` (the same measured predicate the
+      kernel dispatch uses), ``"jnp"`` otherwise. For inference
+      pipelines that want the memory ceiling lifted without thinking;
+      carries the same forward-only caveat whenever it picks flash.
     """
     num_ranks = mesh.shape[axis]
     seq = q.shape[2]
@@ -63,6 +85,22 @@ def ring_attention(
         raise ValueError(f"sequence {seq} not divisible by ring size {num_ranks}")
     s_local = seq // num_ranks
     ring = [(i, (i + 1) % num_ranks) for i in range(num_ranks)]
+
+    if block_impl not in ("auto", "jnp", "flash"):
+        raise ValueError(
+            f"block_impl={block_impl!r}: expected 'auto', 'jnp' or 'flash'"
+        )
+    if block_impl == "auto":
+        from adapt_tpu.ops.attention import scores_over_budget
+
+        local_shape = (q.shape[0], q.shape[1], s_local, q.shape[3])
+        block_impl = (
+            "flash" if scores_over_budget(local_shape, local_shape) else "jnp"
+        )
+    if block_impl == "flash":
+        return _ring_attention_flash(
+            q, k, v, mesh, axis, causal, num_ranks, s_local, ring
+        )
 
     spec = P(None, None, axis, None)
 
@@ -109,6 +147,81 @@ def ring_attention(
         )
         (m, l, o, _, _), _ = lax.scan(step, init, jnp.arange(num_ranks))
         return o / jnp.maximum(l, 1e-20)
+
+    return ringed(q, k, v)
+
+
+def _ring_attention_flash(
+    q, k, v, mesh, axis, causal, num_ranks, s_local, ring
+):
+    """Ring attention whose per-device block compute is the streaming
+    Pallas kernel; per-step normalized results combine exactly via the
+    logsumexp merge (see ``flash_attention_with_lse``'s contract).
+
+    Under causal masking every (rank, step) block is all-or-nothing
+    except the diagonal: the K/V block that originated at ``src`` is
+    fully visible when ``src < rank``, fully masked when ``src > rank``,
+    and plain causal when ``src == rank`` (step 0) — so no positional
+    mask tensor is ever built; the diagonal runs the kernel's own causal
+    path and masked steps contribute ``lse = -inf`` to the merge."""
+    from adapt_tpu.ops.attention import flash_attention_with_lse
+
+    spec = P(None, None, axis, None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        # check_vma=False: pallas_call outputs carry no mesh-varying
+        # annotation (same reason as ulysses_attention).
+        check_vma=False,
+    )
+    def ringed(q_l, k_l, v_l):
+        rank = lax.axis_index(axis)
+        # Step 0: the diagonal block (q and K/V positions coincide).
+        o0, lse = flash_attention_with_lse(q_l, k_l, v_l, causal=causal)
+        o = o0.astype(jnp.float32)
+        k_cur = lax.ppermute(k_l, axis, ring)
+        v_cur = lax.ppermute(v_l, axis, ring)
+
+        def step(carry, i):
+            o, lse, k_cur, v_cur = carry
+            src = jnp.mod(rank - i, num_ranks)
+
+            def live(_):
+                o_j, lse_j = flash_attention_with_lse(
+                    q_l, k_cur, v_cur, causal=False
+                )
+                return o_j.astype(jnp.float32), lse_j
+
+            def dead(_):
+                return (
+                    jnp.zeros(o.shape, jnp.float32),
+                    jnp.full(lse.shape, _NEG_INF, jnp.float32),
+                )
+
+            if causal:
+                o_j, lse_j = lax.cond(src < rank, live, dead, None)
+            else:
+                o_j, lse_j = live(None)
+            m = jnp.maximum(lse, lse_j)
+            w_a = jnp.exp(lse - m)
+            w_b = jnp.exp(lse_j - m)
+            denom = w_a + w_b
+            o_new = (
+                o * w_a[..., None] + o_j * w_b[..., None]
+            ) / denom[..., None]
+            lse_new = m + jnp.log(denom)
+            # Collectives stay unconditional (outside the cond).
+            k_nxt = lax.ppermute(k_cur, axis, ring)
+            v_nxt = lax.ppermute(v_cur, axis, ring)
+            return (o_new, lse_new, k_nxt, v_nxt), None
+
+        (o, lse, _, _), _ = lax.scan(
+            step, (o, lse, k_cur, v_cur), jnp.arange(1, num_ranks)
+        )
+        return o.astype(q_l.dtype)
 
     return ringed(q, k, v)
 
