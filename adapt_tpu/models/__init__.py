@@ -9,9 +9,12 @@ Keras layer naming the reference cuts on (e.g. ``conv3_block1_out``,
 
 from adapt_tpu.models.efficientnet import efficientnet_b0, efficientnet_b4
 from adapt_tpu.models.resnet import resnet50, resnet101, resnet152
+from adapt_tpu.models.transformer_lm import generate, lm_tiny, transformer_lm
 from adapt_tpu.models.vit import vit_b16, vit_tiny
 
-#: name -> (graph factory, canonical input shape HWC)
+#: name -> (graph factory, canonical input shape HWC). Image models only —
+#: the decoder LM (``transformer_lm``) takes token ids and has its own
+#: generate() loop, so it is exported but not registered here.
 MODEL_REGISTRY = {
     "resnet50": (resnet50, (224, 224, 3)),
     "resnet101": (resnet101, (224, 224, 3)),
@@ -31,4 +34,7 @@ __all__ = [
     "efficientnet_b4",
     "vit_b16",
     "vit_tiny",
+    "transformer_lm",
+    "lm_tiny",
+    "generate",
 ]

@@ -1,0 +1,302 @@
+"""Decoder-only transformer LM with KV-cache incremental decoding.
+
+Beyond reference parity (the reference is CNN-only inference,
+SURVEY.md §2.2) but a natural capability for a TPU serving framework:
+the causal-attention product path. The full-sequence forward is a
+``LayerGraph`` cut by decoder block — the same pipeline-partition
+contract as ViT (``models/vit.py``) — while generation runs a
+jit-friendly KV-cache loop:
+
+- **Prefill** consumes the prompt in one full causal forward (the flash
+  attention dispatch in ``ops/attention`` picks XLA or the streaming
+  Pallas kernel by measured score-memory budget) and returns per-block
+  K/V caches padded to ``max_len``.
+- **Decode** is a ``lax.scan`` over steps: one token's q attends over
+  the cache (a single (b, h, 1, max_len) score row — no S x S anything),
+  caches update in place via ``dynamic_update_slice``. Static shapes
+  throughout, so the whole generate loop is one compiled program.
+
+All modules use ``setup`` (not ``nn.compact``) so ``__call__`` (the
+graph/pipeline path), ``prefill`` and ``decode_step`` share one
+parameter structure — the cached decode is a different *schedule* over
+the same weights, never a different model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from adapt_tpu.graph.ir import INPUT, LayerGraph
+from adapt_tpu.ops.attention import flash_attention
+
+_NEG_INF = -1e30
+
+
+class CausalSelfAttention(nn.Module):
+    """Causal MHA sharing weights between the full-sequence path (flash
+    dispatch) and the single-token cached path."""
+
+    dim: int
+    heads: int
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        if self.dim % self.heads:
+            raise ValueError(
+                f"model dim {self.dim} not divisible by {self.heads} heads"
+            )
+        head_dim = self.dim // self.heads
+        self.qkv = nn.DenseGeneral(
+            (3, self.heads, head_dim), dtype=self.dtype, name="qkv"
+        )
+        self.out = nn.Dense(self.dim, dtype=self.dtype, name="out")
+
+    def _project(self, x):
+        qkv = self.qkv(x)  # (b, s, 3, h, hd)
+        q, k, v = jnp.moveaxis(qkv, 2, 0)
+        # -> (b, h, s, hd)
+        return tuple(jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+
+    def __call__(self, x):
+        b, s, d = x.shape
+        q, k, v = self._project(x)
+        o = flash_attention(q, k, v, causal=True)
+        return self.out(jnp.swapaxes(o, 1, 2).reshape(b, s, d))
+
+    def prefill(self, x, max_len: int):
+        """Full causal attention over the prompt, returning output plus
+        K/V caches padded to ``max_len`` (zeros beyond the prompt are
+        masked by position in ``decode_step``)."""
+        b, s, d = x.shape
+        q, k, v = self._project(x)
+        o = flash_attention(q, k, v, causal=True)
+        pad = ((0, 0), (0, 0), (0, max_len - s), (0, 0))
+        return (
+            self.out(jnp.swapaxes(o, 1, 2).reshape(b, s, d)),
+            jnp.pad(k, pad),
+            jnp.pad(v, pad),
+        )
+
+    def decode_step(self, x_t, cache_k, cache_v, index):
+        """One token: write its K/V at ``index``, attend its q over the
+        cache. ``index`` is traced — the same compiled step serves every
+        position."""
+        b = x_t.shape[0]
+        q, k, v = self._project(x_t)  # each (b, h, 1, hd)
+        cache_k = lax.dynamic_update_slice(cache_k, k, (0, 0, index, 0))
+        cache_v = lax.dynamic_update_slice(cache_v, v, (0, 0, index, 0))
+        scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+        s = (
+            jnp.einsum(
+                "bhqd,bhkd->bhqk",
+                q.astype(jnp.float32),
+                cache_k.astype(jnp.float32),
+            )
+            * scale
+        )  # (b, h, 1, max_len)
+        positions = jnp.arange(cache_k.shape[2])
+        s = jnp.where(positions[None, None, None, :] <= index, s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum(
+            "bhqk,bhkd->bhqd", p, cache_v.astype(jnp.float32)
+        ).astype(x_t.dtype)
+        o = jnp.swapaxes(o, 1, 2).reshape(b, 1, self.dim)
+        return self.out(o), cache_k, cache_v
+
+
+class DecoderBlock(nn.Module):
+    """Pre-LN decoder block; residuals stay inside the node so block
+    boundaries are clean pipeline cuts (same contract as ViT's
+    ``EncoderBlock``)."""
+
+    dim: int
+    heads: int
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.ln1 = nn.LayerNorm(dtype=self.dtype)
+        self.attn = CausalSelfAttention(
+            self.dim, self.heads, dtype=self.dtype
+        )
+        self.ln2 = nn.LayerNorm(dtype=self.dtype)
+        self.mlp_in = nn.Dense(self.mlp_dim, dtype=self.dtype)
+        self.mlp_out = nn.Dense(self.dim, dtype=self.dtype)
+
+    def _mlp(self, x):
+        return self.mlp_out(nn.gelu(self.mlp_in(x)))
+
+    def __call__(self, x):
+        x = x + self.attn(self.ln1(x))
+        return x + self._mlp(self.ln2(x))
+
+    def prefill(self, x, max_len: int):
+        a, ck, cv = self.attn.prefill(self.ln1(x), max_len)
+        x = x + a
+        return x + self._mlp(self.ln2(x)), ck, cv
+
+    def decode_step(self, x_t, cache_k, cache_v, index):
+        a, ck, cv = self.attn.decode_step(
+            self.ln1(x_t), cache_k, cache_v, index
+        )
+        x_t = x_t + a
+        return x_t + self._mlp(self.ln2(x_t)), ck, cv
+
+
+class TokenEmbed(nn.Module):
+    """Token + learned positional embeddings."""
+
+    vocab: int
+    dim: int
+    max_len: int
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.tok = nn.Embed(self.vocab, self.dim, dtype=self.dtype)
+        self.pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.dim),
+            jnp.float32,
+        )
+
+    def __call__(self, ids):
+        s = ids.shape[1]
+        return self.tok(ids) + self.pos[:s].astype(self.dtype)
+
+    def embed_at(self, ids_t, index):
+        """Embed a single token column at traced position ``index``."""
+        p = lax.dynamic_slice(self.pos, (index, 0), (1, self.dim))
+        return self.tok(ids_t) + p.astype(self.dtype)
+
+
+class LMHead(nn.Module):
+    """Final LN + vocab projection (logits in f32 for stable sampling)."""
+
+    vocab: int
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.ln = nn.LayerNorm(dtype=self.dtype)
+        self.logits = nn.Dense(self.vocab, dtype=jnp.float32)
+
+    def __call__(self, x):
+        return self.logits(self.ln(x).astype(jnp.float32))
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerLM:
+    """A built LM: the pipeline-partitionable graph plus the decode
+    metadata ``generate`` needs."""
+
+    graph: LayerGraph
+    depth: int
+    max_len: int
+
+    @property
+    def block_names(self) -> list[str]:
+        return [f"decoder_block_{i}" for i in range(self.depth)]
+
+
+def transformer_lm(
+    vocab: int,
+    dim: int,
+    depth: int,
+    heads: int,
+    mlp_dim: int,
+    max_len: int = 1024,
+    dtype: jnp.dtype = jnp.float32,
+    name: str = "transformer_lm",
+) -> TransformerLM:
+    g = LayerGraph(name)
+    prev = g.add(
+        "embed", TokenEmbed(vocab, dim, max_len, dtype=dtype), INPUT
+    )
+    for i in range(depth):
+        prev = g.add(
+            f"decoder_block_{i}",
+            DecoderBlock(dim, heads, mlp_dim, dtype=dtype),
+            prev,
+        )
+    g.add("head", LMHead(vocab, dtype=dtype), prev)
+    return TransformerLM(graph=g, depth=depth, max_len=max_len)
+
+
+def lm_tiny(vocab: int = 256, max_len: int = 64) -> TransformerLM:
+    """Small LM for tests."""
+    return transformer_lm(vocab, 64, 4, 4, 128, max_len, name="lm_tiny")
+
+
+@partial(jax.jit, static_argnames=("lm", "steps"))
+def generate(
+    lm: TransformerLM,
+    variables,
+    prompt: jax.Array,
+    steps: int,
+) -> jax.Array:
+    """Greedy (argmax) generation: one compiled program = prefill over
+    the prompt + a ``lax.scan`` of single-token cached decode steps.
+
+    prompt: (b, s0) int32 token ids, s0 >= 1; returns (b, steps) ids.
+    """
+    g = lm.graph
+    b, s0 = prompt.shape
+    if s0 + steps > lm.max_len:
+        raise ValueError(
+            f"prompt {s0} + steps {steps} exceeds max_len {lm.max_len}"
+        )
+    embed = g.node("embed").module
+    head = g.node("head").module
+    blocks = [g.node(n).module for n in lm.block_names]
+
+    # ---- prefill ---------------------------------------------------------
+    h = embed.apply(variables["embed"], prompt)
+    caches = []
+    for name, block in zip(lm.block_names, blocks):
+        h, ck, cv = block.apply(
+            variables[name], h, lm.max_len, method="prefill"
+        )
+        caches.append((ck, cv))
+    logits = head.apply(variables["head"], h[:, -1:, :])  # (b, 1, V)
+    first = jnp.argmax(logits[:, 0], axis=-1).astype(prompt.dtype)  # (b,)
+
+    # ---- decode ----------------------------------------------------------
+    # Each iteration consumes the carried token and emits its successor,
+    # so steps-1 iterations (plus the prefill's `first`) produce exactly
+    # `steps` tokens with no dead final forward.
+    def step(carry, _):
+        tok, index, caches = carry
+        x_t = embed.apply(
+            variables["embed"], tok[:, None], index, method="embed_at"
+        )  # (b, 1, d)
+        new_caches = []
+        for name, block, (ck, cv) in zip(lm.block_names, blocks, caches):
+            x_t, ck, cv = block.apply(
+                variables[name], x_t, ck, cv, index, method="decode_step"
+            )
+            new_caches.append((ck, cv))
+        lg = head.apply(variables["head"], x_t)[:, 0]  # (b, V)
+        nxt = jnp.argmax(lg, axis=-1).astype(tok.dtype)
+        return (nxt, index + 1, tuple(new_caches)), nxt
+
+    (_, _, _), rest = lax.scan(
+        step,
+        (first, jnp.asarray(s0, jnp.int32), tuple(caches)),
+        None,
+        length=steps - 1,
+    )
+    return jnp.concatenate(
+        [first[:, None], jnp.swapaxes(rest, 0, 1)], axis=1
+    )  # (b, steps)
+
+
+def logits_full(lm: TransformerLM, variables, ids: jax.Array) -> jax.Array:
+    """Full-sequence causal logits — the oracle the cached decode must
+    match position-for-position (and the pipeline-partition path)."""
+    return lm.graph.apply(variables, ids)
