@@ -247,6 +247,8 @@ def generate(
     """
     g = lm.graph
     b, s0 = prompt.shape
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
     if s0 + steps > lm.max_len:
         raise ValueError(
             f"prompt {s0} + steps {steps} exceeds max_len {lm.max_len}"

@@ -16,6 +16,7 @@ host fetch (``np.asarray``) of real outputs.
 from __future__ import annotations
 
 import json
+import os
 
 
 def force_cpu_mesh(n_devices: int) -> None:
@@ -122,3 +123,60 @@ def str_flag(
             if choices is None or value in choices:
                 return value
     return default
+
+
+def run_child_json(
+    cmd: list,
+    metric: str,
+    unit: str,
+    timeout_s: float,
+) -> int:
+    """The shared parent half of the subprocess measurement contract
+    (bench.py's postmortem rules): run ``cmd``, scan stdout for the first
+    parseable '{'-line, reject silent CPU fallbacks inside a TPU
+    measurement, and ALWAYS print exactly one JSON line + return 0 — on
+    failure an error record, never a crash. Drivers that need more than
+    one child mode (artifact writers like mfu_sweep) keep their own
+    loops; every plain one-JSON-line driver should use this."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            cmd,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        record = None
+        for ln in proc.stdout.splitlines():
+            ln = ln.strip()
+            if ln.startswith("{"):
+                try:
+                    record = json.loads(ln)
+                    break
+                except json.JSONDecodeError:
+                    continue  # stray '{'-prefixed noise; keep scanning
+        if proc.returncode == 0 and record is not None:
+            if record.get("platform") == "cpu":
+                err = "TPU run silently fell back to the CPU backend"
+            else:
+                print(json.dumps(record), flush=True)
+                return 0
+        else:
+            err = (proc.stderr or proc.stdout or "").strip()[-300:]
+    except subprocess.TimeoutExpired:
+        err = f"child timed out after {timeout_s:.0f}s (TPU relay hang?)"
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": 0.0,
+                "unit": unit,
+                "vs_baseline": 0.0,
+                "error": err,
+            }
+        ),
+        flush=True,
+    )
+    return 0

@@ -24,12 +24,15 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks.common import int_flag, str_flag  # noqa: E402  (imports no JAX)
+from benchmarks.common import (  # noqa: E402  (imports no JAX)
+    int_flag,
+    run_child_json,
+    str_flag,
+)
 
 TPU_V5E_PEAK_FLOPS = 197e12  # bf16
 
@@ -112,51 +115,12 @@ def main() -> int:
            "--iters", str(iters), "--trials", str(trials)]
     if attn:
         cmd += ["--attn", attn]
-    try:
-        proc = subprocess.run(
-            cmd,
-            capture_output=True,
-            text=True,
-            timeout=900,
-            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        )
-        line = record = None
-        for ln in proc.stdout.splitlines():
-            ln = ln.strip()
-            if ln.startswith("{"):
-                try:
-                    record = json.loads(ln)
-                    line = ln
-                    break
-                except json.JSONDecodeError:
-                    continue  # stray '{'-prefixed noise; keep scanning
-        if proc.returncode == 0 and record is not None:
-            if record.get("platform") == "cpu":
-                # Silent CPU fallback inside a TPU measurement: reject —
-                # a CPU number labeled as chip throughput would read as a
-                # perf regression instead of an environment failure
-                # (bench.py's contract, bench.py:189-193).
-                err = "TPU run silently fell back to the CPU backend"
-            else:
-                print(line, flush=True)
-                return 0
-        else:
-            err = (proc.stderr or proc.stdout or "").strip()[-300:]
-    except subprocess.TimeoutExpired:
-        err = "child timed out after 900s (TPU relay hang?)"
-    print(
-        json.dumps(
-            {
-                "metric": f"{model}_bs{batch}_images_per_sec_per_chip",
-                "value": 0.0,
-                "unit": "images/sec",
-                "vs_baseline": 0.0,
-                "error": err,
-            }
-        ),
-        flush=True,
+    return run_child_json(
+        cmd,
+        metric=f"{model}_bs{batch}_images_per_sec_per_chip",
+        unit="images/sec",
+        timeout_s=900,
     )
-    return 0
 
 
 if __name__ == "__main__":

@@ -246,3 +246,39 @@ def test_lm_generate_rejects_overflow():
     prompt = jnp.zeros((1, 6), jnp.int32)
     with pytest.raises(ValueError, match="max_len"):
         generate(lm, prompt=prompt, variables={}, steps=4)
+
+
+def test_lm_serves_through_pipeline(devices):
+    """The LM graph family works with the serving machinery end-to-end:
+    partitioned at block cuts, pipelined over devices via LocalPipeline,
+    streaming token batches — same contract as the CNN families."""
+    from adapt_tpu.graph.partition import partition
+    from adapt_tpu.models.transformer_lm import lm_tiny, logits_full
+    from adapt_tpu.runtime.pipeline import LocalPipeline
+
+    lm = lm_tiny(vocab=53, max_len=16)
+    ids = [
+        jax.random.randint(jax.random.PRNGKey(i), (2, 9), 0, 53)
+        for i in range(4)
+    ]
+    variables = lm.graph.init(jax.random.PRNGKey(99), ids[0])
+    plan = partition(lm.graph, ["decoder_block_1", "decoder_block_3"])
+    pipe = LocalPipeline(
+        plan, variables, devices=devices[: plan.num_stages]
+    )
+    outs = pipe.stream(ids)
+    for x, y in zip(ids, outs):
+        np.testing.assert_allclose(
+            np.asarray(y),
+            np.asarray(logits_full(lm, variables, x)),
+            rtol=2e-4,
+            atol=2e-4,
+        )
+
+
+def test_lm_generate_rejects_zero_steps():
+    from adapt_tpu.models.transformer_lm import generate, lm_tiny
+
+    lm = lm_tiny(vocab=17, max_len=8)
+    with pytest.raises(ValueError, match="steps"):
+        generate(lm, {}, jnp.zeros((1, 2), jnp.int32), 0)
