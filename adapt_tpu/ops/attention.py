@@ -1,12 +1,14 @@
 """Fused blockwise (flash-style) attention as a Pallas TPU kernel.
 
 Not in the reference (SURVEY.md §2.2: CNN-only, no attention anywhere) but
-first-class here: this is the hot op of the ViT workload (BASELINE.md
-config 5) and the per-device block compute of ring attention
-(``adapt_tpu.parallel.ring_attention``). A fused kernel keeps the S x S
-score matrix out of HBM entirely — scores live in VMEM one (block_q,
-block_k) tile at a time with online-softmax accumulation, so memory is
-O(S * D) instead of O(S^2) and the matmuls stay on the MXU.
+first-class here: the attention entry point for the ViT workload
+(BASELINE.md config 5), the decoder LM, and ring attention's opt-in
+long-shard block compute. Dispatch between this kernel and XLA's fused
+attention is *measured* (see ``FLASH_SCORE_BYTES_BUDGET`` below): XLA
+wins while scores fit, the kernel exists for the long-context regime —
+scores live in VMEM one (block_q, block_k) tile at a time with
+online-softmax accumulation, so memory is O(S * D) instead of O(S^2) and
+the matmuls stay on the MXU.
 
 Grid: (batch*heads, S/block_q, S/block_k), k innermost. Each program
 holds ONE q tile and ONE K/V tile in VMEM; K/V stream from HBM block by
