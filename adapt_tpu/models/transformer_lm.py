@@ -233,19 +233,33 @@ def lm_tiny(vocab: int = 256, max_len: int = 64) -> TransformerLM:
     return transformer_lm(vocab, 64, 4, 4, 128, max_len, name="lm_tiny")
 
 
-@partial(jax.jit, static_argnames=("lm", "steps"))
 def generate(
     lm: TransformerLM,
     variables,
     prompt: jax.Array,
     steps: int,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    eos_id: int | None = None,
+    rng: jax.Array | None = None,
 ) -> jax.Array:
-    """Greedy (argmax) generation: one compiled program = prefill over
-    the prompt + a ``lax.scan`` of single-token cached decode steps.
+    """Generation as one compiled program: prefill over the prompt + a
+    ``lax.scan`` of single-token cached decode steps.
 
     prompt: (b, s0) int32 token ids, s0 >= 1; returns (b, steps) ids.
+
+    Sampling: ``temperature=0`` (default) is greedy argmax and needs no
+    ``rng``; ``temperature > 0`` samples from ``softmax(logits / T)``,
+    optionally truncated to the ``top_k`` highest-probability tokens
+    (the standard serving knobs). ``eos_id`` makes a finished row emit
+    ``eos_id`` forever after — scan length is static, so "stop" means
+    "pad with EOS", the jit-friendly convention.
+
+    Compilation: only the *shape* of the request is static (steps,
+    top_k, and the sample/eos on-off booleans); temperature and eos_id
+    are traced operands, so a server sweeping temperatures per request
+    reuses one compiled program.
     """
-    g = lm.graph
     b, s0 = prompt.shape
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
@@ -253,9 +267,59 @@ def generate(
         raise ValueError(
             f"prompt {s0} + steps {steps} exceeds max_len {lm.max_len}"
         )
+    do_sample = bool(temperature > 0.0)
+    if do_sample and rng is None:
+        raise ValueError("temperature > 0 requires an rng key")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)  # unused by the greedy path
+    return _generate_impl(
+        lm,
+        variables,
+        prompt,
+        jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(-1 if eos_id is None else eos_id, prompt.dtype),
+        rng,
+        steps=steps,
+        do_sample=do_sample,
+        top_k=top_k,
+        use_eos=eos_id is not None,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("lm", "steps", "do_sample", "top_k", "use_eos"),
+)
+def _generate_impl(
+    lm: TransformerLM,
+    variables,
+    prompt: jax.Array,
+    temperature: jax.Array,
+    eos_id: jax.Array,
+    rng: jax.Array,
+    *,
+    steps: int,
+    do_sample: bool,
+    top_k: int | None,
+    use_eos: bool,
+) -> jax.Array:
+    g = lm.graph
+    b, s0 = prompt.shape
     embed = g.node("embed").module
     head = g.node("head").module
     blocks = [g.node(n).module for n in lm.block_names]
+
+    def pick(lg, key):
+        """logits (b, V) -> token ids (b,): greedy or tempered sample."""
+        if not do_sample:
+            return jnp.argmax(lg, axis=-1)
+        lg = lg / temperature
+        if top_k is not None:
+            kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+            lg = jnp.where(lg >= kth, lg, -jnp.inf)
+        return jax.random.categorical(key, lg, axis=-1)
 
     # ---- prefill ---------------------------------------------------------
     h = embed.apply(variables["embed"], prompt)
@@ -266,14 +330,16 @@ def generate(
         )
         caches.append((ck, cv))
     logits = head.apply(variables["head"], h[:, -1:, :])  # (b, 1, V)
-    first = jnp.argmax(logits[:, 0], axis=-1).astype(prompt.dtype)  # (b,)
+    rng, key0 = jax.random.split(rng)
+    first = pick(logits[:, 0], key0).astype(prompt.dtype)  # (b,)
+    done0 = (first == eos_id) if use_eos else jnp.zeros((b,), bool)
 
     # ---- decode ----------------------------------------------------------
     # Each iteration consumes the carried token and emits its successor,
     # so steps-1 iterations (plus the prefill's `first`) produce exactly
     # `steps` tokens with no dead final forward.
-    def step(carry, _):
-        tok, index, caches = carry
+    def step(carry, key):
+        tok, index, done, caches = carry
         x_t = embed.apply(
             variables["embed"], tok[:, None], index, method="embed_at"
         )  # (b, 1, d)
@@ -284,14 +350,18 @@ def generate(
             )
             new_caches.append((ck, cv))
         lg = head.apply(variables["head"], x_t)[:, 0]  # (b, V)
-        nxt = jnp.argmax(lg, axis=-1).astype(tok.dtype)
-        return (nxt, index + 1, tuple(new_caches)), nxt
+        nxt = pick(lg, key).astype(tok.dtype)
+        if use_eos:
+            nxt = jnp.where(done, eos_id.astype(tok.dtype), nxt)
+            done = done | (nxt == eos_id)
+        return (nxt, index + 1, done, tuple(new_caches)), nxt
 
-    (_, _, _), rest = lax.scan(
+    (_, _, _, _), rest = lax.scan(
         step,
-        (first, jnp.asarray(s0, jnp.int32), tuple(caches)),
-        None,
-        length=steps - 1,
+        (first, jnp.asarray(s0, jnp.int32), done0, tuple(caches)),
+        jax.random.split(rng, steps - 1) if steps > 1 else jnp.zeros(
+            (0, 2), jnp.uint32
+        ),
     )
     return jnp.concatenate(
         [first[:, None], jnp.swapaxes(rest, 0, 1)], axis=1

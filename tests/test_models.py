@@ -282,3 +282,42 @@ def test_lm_generate_rejects_zero_steps():
     lm = lm_tiny(vocab=17, max_len=8)
     with pytest.raises(ValueError, match="steps"):
         generate(lm, {}, jnp.zeros((1, 2), jnp.int32), 0)
+
+
+def test_lm_generate_sampling_and_eos():
+    """Serving knobs: top_k=1 sampling degenerates to greedy whatever the
+    temperature; eos_id pads a finished row with EOS forever after."""
+    from adapt_tpu.models.transformer_lm import generate, lm_tiny
+
+    lm = lm_tiny(vocab=31, max_len=24)
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (2, 4), 0, 31)
+    variables = lm.graph.init(jax.random.PRNGKey(7), prompt)
+
+    greedy = np.asarray(generate(lm, variables, prompt, 8))
+    topk1 = np.asarray(
+        generate(
+            lm, variables, prompt, 8,
+            temperature=1.7, top_k=1, rng=jax.random.PRNGKey(8),
+        )
+    )
+    np.testing.assert_array_equal(greedy, topk1)
+
+    # Same key -> same sample; different key -> (here) a different draw.
+    s1 = np.asarray(
+        generate(lm, variables, prompt, 8, temperature=1.0,
+                 rng=jax.random.PRNGKey(9))
+    )
+    s2 = np.asarray(
+        generate(lm, variables, prompt, 8, temperature=1.0,
+                 rng=jax.random.PRNGKey(9))
+    )
+    np.testing.assert_array_equal(s1, s2)
+
+    # EOS: declare the greedy path's first emission to be EOS — every
+    # subsequent token on that row must be EOS too.
+    eos = int(greedy[0, 0])
+    out = np.asarray(generate(lm, variables, prompt, 8, eos_id=eos))
+    assert (out[0] == eos).all()
+
+    with pytest.raises(ValueError, match="rng"):
+        generate(lm, variables, prompt, 4, temperature=0.5)
