@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from adapt_tpu.graph.ir import INPUT, LayerGraph
-from adapt_tpu.ops.attention import flash_attention
+from adapt_tpu.ops.attention import attention_reference, flash_attention
 
 _NEG_INF = -1e30
 
@@ -69,13 +69,24 @@ class CausalSelfAttention(nn.Module):
         o = flash_attention(q, k, v, causal=True)
         return self.out(jnp.swapaxes(o, 1, 2).reshape(b, s, d))
 
-    def prefill(self, x, max_len: int):
+    def prefill(self, x, max_len: int, valid_from=None):
         """Full causal attention over the prompt, returning output plus
         K/V caches padded to ``max_len`` (zeros beyond the prompt are
-        masked by position in ``decode_step``)."""
+        masked by position in ``decode_step``).
+
+        ``valid_from`` (b,) enables ragged batches: row i's keys at
+        positions < valid_from[i] are left-padding and masked out. The
+        masked variant runs the XLA oracle path — the measured dispatch
+        routes practical prompt shapes there anyway, and the Pallas
+        kernel carries no per-row key mask."""
         b, s, d = x.shape
         q, k, v = self._project(x)
-        o = flash_attention(q, k, v, causal=True)
+        if valid_from is None:
+            o = flash_attention(q, k, v, causal=True)
+        else:
+            o = attention_reference(
+                q, k, v, causal=True, valid_from=valid_from
+            )
         pad = ((0, 0), (0, 0), (0, max_len - s), (0, 0))
         return (
             self.out(jnp.swapaxes(o, 1, 2).reshape(b, s, d)),
@@ -83,10 +94,11 @@ class CausalSelfAttention(nn.Module):
             jnp.pad(v, pad),
         )
 
-    def decode_step(self, x_t, cache_k, cache_v, index):
+    def decode_step(self, x_t, cache_k, cache_v, index, valid_from=None):
         """One token: write its K/V at ``index``, attend its q over the
         cache. ``index`` is traced — the same compiled step serves every
-        position."""
+        position. ``valid_from`` (b,) masks a ragged batch's left
+        padding out of the cache window."""
         b = x_t.shape[0]
         q, k, v = self._project(x_t)  # each (b, h, 1, hd)
         cache_k = lax.dynamic_update_slice(cache_k, k, (0, 0, index, 0))
@@ -101,7 +113,10 @@ class CausalSelfAttention(nn.Module):
             * scale
         )  # (b, h, 1, max_len)
         positions = jnp.arange(cache_k.shape[2])
-        s = jnp.where(positions[None, None, None, :] <= index, s, _NEG_INF)
+        live = positions[None, :] <= index
+        if valid_from is not None:
+            live = live & (positions[None, :] >= valid_from[:, None])
+        s = jnp.where(live[:, None, None, :], s, _NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum(
             "bhqk,bhkd->bhqd", p, cache_v.astype(jnp.float32)
@@ -136,14 +151,14 @@ class DecoderBlock(nn.Module):
         x = x + self.attn(self.ln1(x))
         return x + self._mlp(self.ln2(x))
 
-    def prefill(self, x, max_len: int):
-        a, ck, cv = self.attn.prefill(self.ln1(x), max_len)
+    def prefill(self, x, max_len: int, valid_from=None):
+        a, ck, cv = self.attn.prefill(self.ln1(x), max_len, valid_from)
         x = x + a
         return x + self._mlp(self.ln2(x)), ck, cv
 
-    def decode_step(self, x_t, cache_k, cache_v, index):
+    def decode_step(self, x_t, cache_k, cache_v, index, valid_from=None):
         a, ck, cv = self.attn.decode_step(
-            self.ln1(x_t), cache_k, cache_v, index
+            self.ln1(x_t), cache_k, cache_v, index, valid_from
         )
         x_t = x_t + a
         return x_t + self._mlp(self.ln2(x_t)), ck, cv
@@ -174,6 +189,14 @@ class TokenEmbed(nn.Module):
         """Embed a single token column at traced position ``index``."""
         p = lax.dynamic_slice(self.pos, (index, 0), (1, self.dim))
         return self.tok(ids_t) + p.astype(self.dtype)
+
+    def embed_positions(self, ids, pos_ids):
+        """Embed with explicit per-row position ids (ragged batches:
+        a left-padded row's logical positions start at 0 at its first
+        real token, not at buffer column 0)."""
+        return self.tok(ids) + self.pos[jnp.clip(pos_ids, 0)].astype(
+            self.dtype
+        )
 
 
 class LMHead(nn.Module):
@@ -242,11 +265,19 @@ def generate(
     top_k: int | None = None,
     eos_id: int | None = None,
     rng: jax.Array | None = None,
+    prompt_lengths: jax.Array | None = None,
 ) -> jax.Array:
     """Generation as one compiled program: prefill over the prompt + a
     ``lax.scan`` of single-token cached decode steps.
 
     prompt: (b, s0) int32 token ids, s0 >= 1; returns (b, steps) ids.
+
+    Ragged batches: pass right-padded prompts plus ``prompt_lengths``
+    (b,) — rows are left-aligned internally (so every row's next token
+    lands at one shared cache index), position embeddings are row
+    logical (0 at each row's first real token), and the left padding is
+    masked out of every attention window. Each row's output then starts
+    at ITS OWN continuation, exactly as if it had been generated alone.
 
     Sampling: ``temperature=0`` (default) is greedy argmax and needs no
     ``rng``; ``temperature > 0`` samples from ``softmax(logits / T)``,
@@ -274,10 +305,33 @@ def generate(
         raise ValueError(f"top_k must be >= 1, got {top_k}")
     if rng is None:
         rng = jax.random.PRNGKey(0)  # unused by the greedy path
+    if prompt_lengths is None:
+        lengths = jnp.full((b,), s0, jnp.int32)
+    else:
+        lengths = jnp.asarray(prompt_lengths, jnp.int32)
+        if lengths.shape != (b,):
+            raise ValueError(
+                f"prompt_lengths shape {lengths.shape} != ({b},)"
+            )
+        # Out-of-range lengths would silently gather a corrupted prompt
+        # (clip hides it). Validate eagerly when values are concrete;
+        # traced callers (generate under an outer jit) must pre-validate.
+        try:
+            import numpy as _np
+
+            lv = _np.asarray(lengths)
+        except jax.errors.TracerArrayConversionError:
+            pass
+        else:
+            if (lv < 1).any() or (lv > s0).any():
+                raise ValueError(
+                    f"prompt_lengths must be in [1, {s0}], got {lv}"
+                )
     return _generate_impl(
         lm,
         variables,
         prompt,
+        lengths,
         jnp.asarray(temperature, jnp.float32),
         jnp.asarray(-1 if eos_id is None else eos_id, prompt.dtype),
         rng,
@@ -285,17 +339,19 @@ def generate(
         do_sample=do_sample,
         top_k=top_k,
         use_eos=eos_id is not None,
+        ragged=prompt_lengths is not None,
     )
 
 
 @partial(
     jax.jit,
-    static_argnames=("lm", "steps", "do_sample", "top_k", "use_eos"),
+    static_argnames=("lm", "steps", "do_sample", "top_k", "use_eos", "ragged"),
 )
 def _generate_impl(
     lm: TransformerLM,
     variables,
     prompt: jax.Array,
+    lengths: jax.Array,
     temperature: jax.Array,
     eos_id: jax.Array,
     rng: jax.Array,
@@ -304,6 +360,7 @@ def _generate_impl(
     do_sample: bool,
     top_k: int | None,
     use_eos: bool,
+    ragged: bool,
 ) -> jax.Array:
     g = lm.graph
     b, s0 = prompt.shape
@@ -311,22 +368,43 @@ def _generate_impl(
     head = g.node("head").module
     blocks = [g.node(n).module for n in lm.block_names]
 
+    if ragged:
+        # Left-align: row i shifts right by pad_i = s0 - len_i, so every
+        # row's last real token sits at buffer column s0-1 and decode
+        # shares one scalar cache index across the batch.
+        pad = (s0 - lengths)[:, None]  # (b, 1)
+        cols = jnp.arange(s0)[None, :]
+        src = jnp.clip(cols - pad, 0)
+        prompt = jnp.take_along_axis(prompt, src, axis=1)
+        pos_ids = cols - pad  # logical positions; negatives are padding
+        valid_from = pad[:, 0]
+    else:
+        pos_ids = None
+        valid_from = None
+
     def pick(lg, key):
         """logits (b, V) -> token ids (b,): greedy or tempered sample."""
         if not do_sample:
             return jnp.argmax(lg, axis=-1)
         lg = lg / temperature
         if top_k is not None:
-            kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+            # lax.top_k, not a full vocab sort: this runs once per decoded
+            # token on the serving hot path.
+            kth = lax.top_k(lg, top_k)[0][:, -1:]
             lg = jnp.where(lg >= kth, lg, -jnp.inf)
         return jax.random.categorical(key, lg, axis=-1)
 
     # ---- prefill ---------------------------------------------------------
-    h = embed.apply(variables["embed"], prompt)
+    if ragged:
+        h = embed.apply(
+            variables["embed"], prompt, pos_ids, method="embed_positions"
+        )
+    else:
+        h = embed.apply(variables["embed"], prompt)
     caches = []
     for name, block in zip(lm.block_names, blocks):
         h, ck, cv = block.apply(
-            variables[name], h, lm.max_len, method="prefill"
+            variables[name], h, lm.max_len, valid_from, method="prefill"
         )
         caches.append((ck, cv))
     logits = head.apply(variables["head"], h[:, -1:, :])  # (b, 1, V)
@@ -340,13 +418,28 @@ def _generate_impl(
     # `steps` tokens with no dead final forward.
     def step(carry, key):
         tok, index, done, caches = carry
-        x_t = embed.apply(
-            variables["embed"], tok[:, None], index, method="embed_at"
-        )  # (b, 1, d)
+        if ragged:
+            # Logical position differs per row (index - left padding).
+            x_t = embed.apply(
+                variables["embed"],
+                tok[:, None],
+                (index - valid_from)[:, None],
+                method="embed_positions",
+            )
+        else:
+            x_t = embed.apply(
+                variables["embed"], tok[:, None], index, method="embed_at"
+            )  # (b, 1, d)
         new_caches = []
         for name, block, (ck, cv) in zip(lm.block_names, blocks, caches):
             x_t, ck, cv = block.apply(
-                variables[name], x_t, ck, cv, index, method="decode_step"
+                variables[name],
+                x_t,
+                ck,
+                cv,
+                index,
+                valid_from,
+                method="decode_step",
             )
             new_caches.append((ck, cv))
         lg = head.apply(variables["head"], x_t)[:, 0]  # (b, V)

@@ -728,14 +728,21 @@ _flash_vjp.defvjp(_flash_fwd, _flash_bwd)
 
 
 def attention_reference(
-    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    valid_from: jax.Array | None = None,
 ) -> jax.Array:
-    """Pure-jnp oracle: softmax(QK^T / sqrt(d)) V with optional causal mask.
+    """Pure-jnp oracle: softmax(QK^T / sqrt(d)) V with optional masks.
 
     Causal convention (same as the kernel): query at absolute position i
     attends keys at absolute positions j <= i — top-left aligned, which is
     the identity convention for the self-attention (s_q == s_k) shapes the
-    framework uses.
+    framework uses. ``valid_from`` (b,) additionally masks each row's
+    keys at positions < valid_from[row] — left-padding in ragged batches
+    (the LM's masked prefill). One oracle, one set of masking/precision
+    conventions.
     """
     d = q.shape[-1]
     s = jnp.einsum(
@@ -745,6 +752,10 @@ def attention_reference(
         s_q, s_k = s.shape[-2:]
         mask = jnp.tril(jnp.ones((s_q, s_k), bool))
         s = jnp.where(mask, s, _NEG_INF)
+    if valid_from is not None:
+        cols = jnp.arange(s.shape[-1])
+        live = cols[None, :] >= valid_from[:, None]  # (b, s_k)
+        s = jnp.where(live[:, None, None, :], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(
         q.dtype

@@ -321,3 +321,46 @@ def test_lm_generate_sampling_and_eos():
 
     with pytest.raises(ValueError, match="rng"):
         generate(lm, variables, prompt, 4, temperature=0.5)
+
+
+def test_lm_generate_ragged_prompts_match_per_row():
+    """Batched ragged generation (right-padded prompts + prompt_lengths)
+    must emit, per row, exactly what generating that row alone emits —
+    left-alignment, per-row position ids, and padding masks are internal
+    bookkeeping, never visible in the output."""
+    from adapt_tpu.models.transformer_lm import generate, lm_tiny
+
+    lm = lm_tiny(vocab=43, max_len=24)
+    lens = [3, 7, 5]
+    s0 = max(lens)
+    rows = [
+        jax.random.randint(jax.random.PRNGKey(10 + i), (1, n), 0, 43)
+        for i, n in enumerate(lens)
+    ]
+    variables = lm.graph.init(jax.random.PRNGKey(20), rows[1])
+
+    batched = jnp.zeros((len(lens), s0), jnp.int32)
+    for i, r in enumerate(rows):
+        batched = batched.at[i, : lens[i]].set(r[0])
+    out = np.asarray(
+        generate(
+            lm, variables, batched, 6,
+            prompt_lengths=jnp.asarray(lens),
+        )
+    )
+    for i, r in enumerate(rows):
+        solo = np.asarray(generate(lm, variables, r, 6))
+        np.testing.assert_array_equal(out[i], solo[0], err_msg=f"row {i}")
+
+
+def test_lm_generate_rejects_bad_prompt_lengths():
+    from adapt_tpu.models.transformer_lm import generate, lm_tiny
+
+    lm = lm_tiny(vocab=11, max_len=16)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    with pytest.raises(ValueError, match="prompt_lengths"):
+        generate(lm, {}, prompt, 2, prompt_lengths=jnp.asarray([2, 6]))
+    with pytest.raises(ValueError, match="prompt_lengths"):
+        generate(lm, {}, prompt, 2, prompt_lengths=jnp.asarray([0, 3]))
+    with pytest.raises(ValueError, match="shape"):
+        generate(lm, {}, prompt, 2, prompt_lengths=jnp.asarray([3]))
