@@ -229,8 +229,12 @@ def test_membership_fuzz_with_cross_host_join(rng, devices):
         assert completed + failed == n_requests
         # Invariant 2: >= 1 worker always lived, so the stream survives.
         assert completed >= n_requests * 0.9, (completed, failed)
-        # Invariant 3: the joiner actually became a member.
-        deadline = time.monotonic() + 20.0
+        # Invariant 3: the joiner actually became a member. The deadline
+        # covers a cold `python -m` child (jax+flax import) on a LOADED
+        # machine — 20 s flaked when the full suite ran alongside other
+        # work; registration itself is milliseconds once the process is
+        # up.
+        deadline = time.monotonic() + 60.0
         while "fuzz-joiner" not in disp.registry.alive():
             assert time.monotonic() < deadline, "joiner never registered"
             time.sleep(0.05)
