@@ -364,3 +364,66 @@ def test_lm_generate_rejects_bad_prompt_lengths():
         generate(lm, {}, prompt, 2, prompt_lengths=jnp.asarray([0, 3]))
     with pytest.raises(ValueError, match="shape"):
         generate(lm, {}, prompt, 2, prompt_lengths=jnp.asarray([3]))
+
+
+def test_lm_generate_int8_kv_cache():
+    """kv_cache_dtype='int8' stores (int8 values, f32 scales) caches.
+    Teacher-forced logits through the quantized cache must track the
+    native-cache logits closely (absmax-per-vector int8, ~0.4% scale
+    granularity), and greedy generation runs end to end."""
+    from adapt_tpu.models.transformer_lm import generate, lm_tiny
+
+    lm = lm_tiny(vocab=37, max_len=24)
+    prompt = jax.random.randint(jax.random.PRNGKey(30), (2, 6), 0, 37)
+    variables = lm.graph.init(jax.random.PRNGKey(31), prompt)
+
+    g = lm.graph
+    embed = g.node("embed").module
+    head = g.node("head").module
+    blocks = [g.node(n).module for n in lm.block_names]
+
+    # One FIXED token sequence feeds both runs (true teacher forcing):
+    # a quantization-induced argmax flip must not send the two runs down
+    # different decode paths, or the logits comparison is meaningless.
+    forced = jax.random.randint(jax.random.PRNGKey(32), (4, 2), 0, 37)
+
+    def run(quant):
+        h = embed.apply(variables["embed"], prompt)
+        caches = []
+        for name, block in zip(lm.block_names, blocks):
+            h, ck, cv = block.apply(
+                variables[name], h, lm.max_len, None, quant,
+                method="prefill",
+            )
+            caches.append([ck, cv])
+        logits = [np.asarray(head.apply(variables["head"], h[:, -1:]))]
+        for step, t in enumerate(range(6, 10)):
+            x_t = embed.apply(
+                variables["embed"], forced[step][:, None], t,
+                method="embed_at",
+            )
+            for i, (name, block) in enumerate(zip(lm.block_names, blocks)):
+                x_t, ck, cv = block.apply(
+                    variables[name], x_t, *caches[i], t, None, quant,
+                    method="decode_step",
+                )
+                caches[i] = [ck, cv]
+            logits.append(np.asarray(head.apply(variables["head"], x_t)))
+        return np.concatenate(logits, axis=1), caches
+
+    lg_native, _ = run(False)
+    lg_int8, caches = run(True)
+    assert caches[0][0][0].dtype == jnp.int8
+    assert caches[0][0][1].dtype == jnp.float32
+    scale = np.abs(lg_native).max()
+    np.testing.assert_allclose(
+        lg_int8 / scale, lg_native / scale, atol=0.05
+    )
+
+    out = np.asarray(
+        generate(lm, variables, prompt, 6, kv_cache_dtype="int8")
+    )
+    assert out.shape == (2, 6) and (out >= 0).all() and (out < 37).all()
+
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        generate(lm, variables, prompt, 2, kv_cache_dtype="fp8")
