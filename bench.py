@@ -54,13 +54,16 @@ RESNET50_FLOPS_PER_IMAGE = 8.2e9  # fwd pass @224x224, mul+add as 2
 TPU_V5E_PEAK_FLOPS = 197e12  # bf16
 BATCH = 32
 
-#: (platform, iters, trials, timeout_s, backoff_before_s). TPU gets two
+#: (platform, iters, trials, timeout_s, backoff_before_s). TPU gets three
 #: shots (first compile through the tunnel is slow; a flaky relay often
-#: recovers within a minute); CPU is the evidence-of-life fallback with a
-#: small iteration count — ResNet-50 bs=32 on CPU is ~seconds per batch.
+#: recovers within a minute — and this round saw multi-hour outages, so a
+#: final attempt after a 5-minute backoff buys one more recovery window);
+#: CPU is the evidence-of-life fallback with a small iteration count —
+#: ResNet-50 bs=32 on CPU is ~seconds per batch.
 ATTEMPTS = [
     ("tpu", 100, 5, 600, 0),
     ("tpu", 100, 3, 420, 30),
+    ("tpu", 100, 3, 420, 300),
     ("cpu", 3, 2, 600, 0),
 ]
 

@@ -21,6 +21,8 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BATCHES = [16, 32, 64, 128, 256]
+#: Must exceed bench.py's worst-case attempt schedule (2370s, see below).
+PER_BATCH_TIMEOUT_S = 2700
 
 
 def main() -> None:
@@ -33,14 +35,15 @@ def main() -> None:
     for batch in BATCHES:
         t0 = time.time()
         # Timeout must exceed bench.py's own worst-case attempt schedule
-        # (600s tpu + 30s backoff + 420s tpu retry + 600s cpu fallback);
-        # a breach is recorded as a row, never allowed to lose the sweep.
+        # (600s tpu + 30s + 420s retry + 300s backoff + 420s retry +
+        # 600s cpu fallback = 2370s); a breach is recorded as a row,
+        # never allowed to lose the sweep.
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.join(REPO, "bench.py"), "--batch", str(batch)],
                 capture_output=True,
                 text=True,
-                timeout=1800,
+                timeout=PER_BATCH_TIMEOUT_S,
                 cwd=REPO,
             )
             line = next(
@@ -53,7 +56,7 @@ def main() -> None:
             )
             row = json.loads(line) if line else {"error": proc.stderr[-300:]}
         except subprocess.TimeoutExpired:
-            row = {"error": "sweep-level timeout (1800s)"}
+            row = {"error": f"sweep-level timeout ({PER_BATCH_TIMEOUT_S}s)"}
         row["batch"] = row.get("batch", batch)
         row["wall_s"] = round(time.time() - t0, 1)
         rows.append(row)
