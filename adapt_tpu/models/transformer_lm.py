@@ -289,6 +289,12 @@ class TransformerLM:
     max_len: int
 
     @property
+    def vocab(self) -> int:
+        """Read from the head module — one source of truth, no field that
+        could drift from the actual logits dimension."""
+        return self.graph.node("head").module.vocab
+
+    @property
     def block_names(self) -> list[str]:
         return [f"decoder_block_{i}" for i in range(self.depth)]
 
@@ -377,6 +383,10 @@ def generate(
         raise ValueError("temperature > 0 requires an rng key")
     if top_k is not None and top_k < 1:
         raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_k is not None and top_k > lm.vocab:
+        # lax.top_k with k > axis size fails at trace time with an opaque
+        # XLA error; name the real constraint instead.
+        raise ValueError(f"top_k {top_k} exceeds vocab size {lm.vocab}")
     if kv_cache_dtype not in ("native", "int8"):
         raise ValueError(
             f"kv_cache_dtype={kv_cache_dtype!r}: expected 'native' or 'int8'"

@@ -51,8 +51,15 @@ _NEG_INF = -1e30
 #: faster through XLA (3,360 vs 1,781 img/s) — but score memory grows
 #: O(S^2): at 2 GiB+ it crowds out everything else in 16 GiB HBM (and at
 #: s=32k, 51.5 GiB, XLA simply OOMs) while the streaming kernel stays
-#: O(S*D). The measured crossover sits in the same region: flash already
-#: beats XLA at (1, 12, 8192) = 3 GiB scores. ``prefer=`` overrides.
+#: O(S*D). Past the budget the throughput data is NON-monotonic, not a
+#: clean crossover: attn_longseq.json has flash 5% faster at
+#: (1, 12, 8192) = 3 GiB scores but XLA 24% faster again at 16384 =
+#: 12 GiB. The dispatch keys on capacity, not that noisy margin: a
+#: 12 GiB transient score tensor in 16 GiB HBM leaves nothing for
+#: weights/caches/activations in a real serving process (the standalone
+#: sweep that survives it has the chip to itself), so past ~2 GiB the
+#: O(S*D) kernel wins on headroom even where XLA wins the sweep.
+#: ``prefer=`` overrides when sweep throughput is all that matters.
 FLASH_SCORE_BYTES_BUDGET = 2 << 30
 
 #: Absolute guard on top of the byte budget: at or past this key length

@@ -70,9 +70,10 @@ def ring_attention(
       even at 32k-token *shards* (the regime where a materialized score
       block is itself gigabytes — same wall as
       ``benchmarks/results/r03/attn_longseq.json``). FORWARD-ONLY: the
-      lse entry point has no VJP, so ``jax.grad`` through it fails
-      loudly at the pallas_call — an explicit serving-path opt-in, which
-      is why it is not the default.
+      lse entry point has no VJP; ``jax.grad`` through it raises a
+      ``NotImplementedError`` naming ``block_impl`` at this function's
+      boundary — an explicit serving-path opt-in, which is why it is
+      not the default.
     - ``"auto"`` — ``"flash"`` exactly when a single score block busts
       ``FLASH_SCORE_BYTES_BUDGET`` (the same measured predicate the
       kernel dispatch uses), ``"jnp"`` otherwise. For inference
@@ -98,9 +99,37 @@ def ring_attention(
             "flash" if scores_over_budget(local_shape, local_shape) else "jnp"
         )
     if block_impl == "flash":
-        return _ring_attention_flash(
-            q, k, v, mesh, axis, causal, num_ranks, s_local, ring
+        # custom_vjp wrapper so differentiating (e.g. a training run whose
+        # sequence length grew past the budget while "auto" silently
+        # switched to flash) fails at THIS boundary with a message naming
+        # block_impl — not deep inside pallas_call internals.
+        kw = dict(
+            mesh=mesh,
+            axis=axis,
+            causal=causal,
+            num_ranks=num_ranks,
+            s_local=s_local,
+            ring=ring,
         )
+
+        @jax.custom_vjp
+        def run(q, k, v):
+            return _ring_attention_flash(q, k, v, **kw)
+
+        def fwd(q, k, v):
+            return _ring_attention_flash(q, k, v, **kw), None
+
+        def bwd(_, g):
+            raise NotImplementedError(
+                "ring_attention block_impl='flash' (including 'auto' "
+                "resolving to flash at this shard shape) is forward-only: "
+                "the streaming-kernel lse entry point has no VJP. Use "
+                "block_impl='jnp' for training, or shrink the per-shard "
+                "score block under FLASH_SCORE_BYTES_BUDGET."
+            )
+
+        run.defvjp(fwd, bwd)
+        return run(q, k, v)
 
     spec = P(None, None, axis, None)
 
@@ -163,7 +192,17 @@ def _ring_attention_flash(
     fully visible when ``src < rank``, fully masked when ``src > rank``,
     and plain causal when ``src == rank`` (step 0) — so no positional
     mask tensor is ever built; the diagonal runs the kernel's own causal
-    path and masked steps contribute ``lse = -inf`` to the merge."""
+    path and masked steps contribute ``lse = -inf`` to the merge.
+
+    The ``lax.cond`` on ``src < rank`` is *correctness* masking, not a
+    compute skip: under SPMD the predicate is device-varying, so XLA
+    lowers the cond to running both branches and selecting — every rank
+    pays the full kernel on its dead steps too. Shortening the loop
+    per-rank cannot fix this: the ``ppermute`` rotation must run the
+    same number of times on every rank or the collective deadlocks, so
+    the causal ring's lower triangle is latency floor, not saved work
+    (the classic fix — striped/zigzag block placement to balance live
+    work per rank — is a layout change, not a cond)."""
     from adapt_tpu.ops.attention import flash_attention_with_lse
 
     spec = P(None, None, axis, None)

@@ -100,7 +100,8 @@ def main() -> int:
     # default "" follows ops.attention's measured dispatch.
     attn = str_flag(sys.argv, "--attn", "", choices=("", "pallas", "xla"))
     if attn and model != "vit_b16":
-        print(json.dumps({"metric": f"{model}_bs{batch}_images_per_sec_per_chip",
+        print(json.dumps({"metric": f"{model}_bs{batch}_images_per_sec_per_chip"
+                                    f"_attn_{attn}",
                           "value": 0.0, "unit": "images/sec",
                           "vs_baseline": 0.0,
                           "error": "--attn applies only to vit_b16 "
@@ -117,7 +118,10 @@ def main() -> int:
         cmd += ["--attn", attn]
     return run_child_json(
         cmd,
-        metric=f"{model}_bs{batch}_images_per_sec_per_chip",
+        # Same suffix the child uses on success, so a failed --attn A/B run
+        # emits its error row under the A/B metric, never the baseline's.
+        metric=f"{model}_bs{batch}_images_per_sec_per_chip"
+        + (f"_attn_{attn}" if attn else ""),
         unit="images/sec",
         timeout_s=900,
     )
