@@ -328,49 +328,67 @@ def lm_tiny(vocab: int = 256, max_len: int = 64) -> TransformerLM:
     return transformer_lm(vocab, 64, 4, 4, 128, max_len, name="lm_tiny")
 
 
-def generate(
+def sample_next_tokens(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: jax.Array,
+    *,
+    do_sample: bool,
+    top_k: int | None,
+    row_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """logits (n, V) -> (n,) token ids: greedy argmax, or sample from
+    ``softmax(logits / temperature)`` optionally truncated to ``top_k``.
+
+    Sampling keys are PER ROW — the step key folded with the row's
+    *global* batch index (``row_offset + i``) — so any contiguous slice
+    of a batch draws exactly what the full batch draws for those rows.
+    That slice-invariance is what lets pipelined decode
+    (:mod:`adapt_tpu.parallel.pipeline_decode`), which samples one
+    microbatch at a time on the last pipeline rank, match single-program
+    :func:`generate` token-for-token even at temperature > 0."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1)
+    lg = logits / temperature
+    if top_k is not None:
+        # lax.top_k, not a full vocab sort: this runs once per decoded
+        # token on the serving hot path.
+        kth = lax.top_k(lg, top_k)[0][:, -1:]
+        lg = jnp.where(lg >= kth, lg, -jnp.inf)
+    rows = row_offset + jnp.arange(lg.shape[0])
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, rows)
+    return jax.vmap(jax.random.categorical)(keys, lg)
+
+
+def _left_align(prompt: jax.Array, lengths: jax.Array):
+    """Right-padded ragged rows -> (left-aligned buffer, per-row logical
+    position ids, per-row left-pad counts). Row i shifts right by
+    ``s0 - lengths[i]`` so every row's last real token sits at buffer
+    column s0-1 and decode shares one scalar cache index across the
+    batch; logical positions are 0 at each row's first real token
+    (negatives mark padding)."""
+    _, s0 = prompt.shape
+    pad = (s0 - lengths)[:, None]  # (b, 1)
+    cols = jnp.arange(s0)[None, :]
+    src = jnp.clip(cols - pad, 0)
+    aligned = jnp.take_along_axis(prompt, src, axis=1)
+    pos_ids = cols - pad
+    return aligned, pos_ids, pad[:, 0]
+
+
+def validate_generate_args(
     lm: TransformerLM,
-    variables,
     prompt: jax.Array,
     steps: int,
-    temperature: float = 0.0,
-    top_k: int | None = None,
-    eos_id: int | None = None,
-    rng: jax.Array | None = None,
-    prompt_lengths: jax.Array | None = None,
-    kv_cache_dtype: str = "native",
-) -> jax.Array:
-    """Generation as one compiled program: prefill over the prompt + a
-    ``lax.scan`` of single-token cached decode steps.
-
-    prompt: (b, s0) int32 token ids, s0 >= 1; returns (b, steps) ids.
-
-    Ragged batches: pass right-padded prompts plus ``prompt_lengths``
-    (b,) — rows are left-aligned internally (so every row's next token
-    lands at one shared cache index), position embeddings are row
-    logical (0 at each row's first real token), and the left padding is
-    masked out of every attention window. Each row's output then starts
-    at ITS OWN continuation, exactly as if it had been generated alone.
-
-    ``kv_cache_dtype="int8"`` stores the KV cache quantized (absmax
-    int8 per key/value vector): decode re-reads the whole cache from
-    HBM every step, so this cuts the bandwidth-bound cache traffic
-    (~2x vs bf16 caches, 4x vs f32) and fits the same factor more
-    context per chip, at a small logits perturbation (tested against
-    the native-cache path).
-
-    Sampling: ``temperature=0`` (default) is greedy argmax and needs no
-    ``rng``; ``temperature > 0`` samples from ``softmax(logits / T)``,
-    optionally truncated to the ``top_k`` highest-probability tokens
-    (the standard serving knobs). ``eos_id`` makes a finished row emit
-    ``eos_id`` forever after — scan length is static, so "stop" means
-    "pad with EOS", the jit-friendly convention.
-
-    Compilation: only the *shape* of the request is static (steps,
-    top_k, and the sample/eos on-off booleans); temperature and eos_id
-    are traced operands, so a server sweeping temperatures per request
-    reuses one compiled program.
-    """
+    temperature: float,
+    top_k: int | None,
+    rng: jax.Array | None,
+    prompt_lengths: jax.Array | None,
+    kv_cache_dtype: str,
+) -> tuple[jax.Array, jax.Array, bool]:
+    """Shared request validation for :func:`generate` and the pipelined
+    decoder: returns ``(lengths, rng, do_sample)`` with every constraint
+    checked eagerly (clear ValueErrors instead of opaque trace errors)."""
     b, s0 = prompt.shape
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
@@ -415,6 +433,56 @@ def generate(
                 raise ValueError(
                     f"prompt_lengths must be in [1, {s0}], got {lv}"
                 )
+    return lengths, rng, do_sample
+
+
+def generate(
+    lm: TransformerLM,
+    variables,
+    prompt: jax.Array,
+    steps: int,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    eos_id: int | None = None,
+    rng: jax.Array | None = None,
+    prompt_lengths: jax.Array | None = None,
+    kv_cache_dtype: str = "native",
+) -> jax.Array:
+    """Generation as one compiled program: prefill over the prompt + a
+    ``lax.scan`` of single-token cached decode steps.
+
+    prompt: (b, s0) int32 token ids, s0 >= 1; returns (b, steps) ids.
+
+    Ragged batches: pass right-padded prompts plus ``prompt_lengths``
+    (b,) — rows are left-aligned internally (so every row's next token
+    lands at one shared cache index), position embeddings are row
+    logical (0 at each row's first real token), and the left padding is
+    masked out of every attention window. Each row's output then starts
+    at ITS OWN continuation, exactly as if it had been generated alone.
+
+    ``kv_cache_dtype="int8"`` stores the KV cache quantized (absmax
+    int8 per key/value vector): decode re-reads the whole cache from
+    HBM every step, so this cuts the bandwidth-bound cache traffic
+    (~2x vs bf16 caches, 4x vs f32) and fits the same factor more
+    context per chip, at a small logits perturbation (tested against
+    the native-cache path).
+
+    Sampling: ``temperature=0`` (default) is greedy argmax and needs no
+    ``rng``; ``temperature > 0`` samples from ``softmax(logits / T)``,
+    optionally truncated to the ``top_k`` highest-probability tokens
+    (the standard serving knobs). ``eos_id`` makes a finished row emit
+    ``eos_id`` forever after — scan length is static, so "stop" means
+    "pad with EOS", the jit-friendly convention.
+
+    Compilation: only the *shape* of the request is static (steps,
+    top_k, and the sample/eos on-off booleans); temperature and eos_id
+    are traced operands, so a server sweeping temperatures per request
+    reuses one compiled program.
+    """
+    lengths, rng, do_sample = validate_generate_args(
+        lm, prompt, steps, temperature, top_k, rng, prompt_lengths,
+        kv_cache_dtype,
+    )
     return _generate_impl(
         lm,
         variables,
@@ -461,30 +529,17 @@ def _generate_impl(
     blocks = [g.node(n).module for n in lm.block_names]
 
     if ragged:
-        # Left-align: row i shifts right by pad_i = s0 - len_i, so every
-        # row's last real token sits at buffer column s0-1 and decode
-        # shares one scalar cache index across the batch.
-        pad = (s0 - lengths)[:, None]  # (b, 1)
-        cols = jnp.arange(s0)[None, :]
-        src = jnp.clip(cols - pad, 0)
-        prompt = jnp.take_along_axis(prompt, src, axis=1)
-        pos_ids = cols - pad  # logical positions; negatives are padding
-        valid_from = pad[:, 0]
+        prompt, pos_ids, valid_from = _left_align(prompt, lengths)
     else:
         pos_ids = None
         valid_from = None
 
     def pick(lg, key):
-        """logits (b, V) -> token ids (b,): greedy or tempered sample."""
-        if not do_sample:
-            return jnp.argmax(lg, axis=-1)
-        lg = lg / temperature
-        if top_k is not None:
-            # lax.top_k, not a full vocab sort: this runs once per decoded
-            # token on the serving hot path.
-            kth = lax.top_k(lg, top_k)[0][:, -1:]
-            lg = jnp.where(lg >= kth, lg, -jnp.inf)
-        return jax.random.categorical(key, lg, axis=-1)
+        """logits (b, V) -> token ids (b,); per-row keys (see
+        sample_next_tokens)."""
+        return sample_next_tokens(
+            lg, key, temperature, do_sample=do_sample, top_k=top_k
+        )
 
     # ---- prefill ---------------------------------------------------------
     if ragged:

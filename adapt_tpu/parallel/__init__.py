@@ -1,3 +1,7 @@
+from adapt_tpu.parallel.pipeline_decode import (
+    pipelined_generate,
+    shard_for_pipeline,
+)
 from adapt_tpu.parallel.pipeline_spmd import spmd_pipeline, stack_stage_params
 from adapt_tpu.parallel.ring_attention import ring_attention
 from adapt_tpu.parallel.ulysses import ulysses_attention
@@ -9,6 +13,8 @@ from adapt_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "pipelined_generate",
+    "shard_for_pipeline",
     "spmd_pipeline",
     "stack_stage_params",
     "ring_attention",

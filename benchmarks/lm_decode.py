@@ -17,7 +17,17 @@ exit 0. The decode loop lives on-device (scan), timed around a host
 fetch, with distinct prompts per trial (the tunnel dedups identical
 dispatches).
 
-Usage: ``python benchmarks/lm_decode.py [--batch 8] [--steps 128]``
+``--kv int8`` runs the same measurement with the quantized KV cache
+(``kv_cache_dtype="int8"``), the A/B that settles whether the cache
+bandwidth claim (~2x fewer cache bytes than the native bf16 cache)
+survives XLA's fusion of the dequant — measure at a long context
+(``--prompt 1024 --maxlen 2048``) where cache traffic rivals weight
+traffic, or the weights term hides the difference. The MBU denominator
+counts weight bytes + per-step mean cache bytes actually resident, so
+vs_baseline stays honest across cache dtypes.
+
+Usage: ``python benchmarks/lm_decode.py [--batch 8] [--steps 128]
+[--prompt 64] [--maxlen 256] [--kv native|int8]``
 """
 
 from __future__ import annotations
@@ -30,14 +40,20 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks.common import int_flag, run_child_json  # noqa: E402  (no JAX)
+from benchmarks.common import (  # noqa: E402  (imports no JAX)
+    int_flag,
+    run_child_json,
+    str_flag,
+)
 
 VOCAB, DIM, DEPTH, HEADS, MLP = 50257, 768, 12, 12, 3072
-PROMPT_LEN, MAX_LEN = 64, 256
 TPU_V5E_HBM_BYTES_PER_S = 819e9
 
 
-def _child(batch: int, steps: int, trials: int) -> None:
+def _child(
+    batch: int, steps: int, trials: int, prompt_len: int, max_len: int,
+    kv: str,
+) -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -45,10 +61,10 @@ def _child(batch: int, steps: int, trials: int) -> None:
     from adapt_tpu.models.transformer_lm import generate, transformer_lm
 
     lm = transformer_lm(
-        VOCAB, DIM, DEPTH, HEADS, MLP, max_len=MAX_LEN, dtype=jnp.bfloat16
+        VOCAB, DIM, DEPTH, HEADS, MLP, max_len=max_len, dtype=jnp.bfloat16
     )
     key = jax.random.PRNGKey(0)
-    prompt = jax.random.randint(key, (batch, PROMPT_LEN), 0, VOCAB)
+    prompt = jax.random.randint(key, (batch, prompt_len), 0, VOCAB)
     variables = jax.jit(lm.graph.init)(jax.random.PRNGKey(1), prompt)
     # Serving weights are bf16-resident (decode is bandwidth-bound; f32
     # residency would double the bytes every step streams). param_bytes
@@ -70,34 +86,50 @@ def _child(batch: int, steps: int, trials: int) -> None:
             times.append(time.perf_counter() - t0)
         return statistics.median(times)
 
-    cached_s = timed(lambda p: generate(lm, variables, p, steps), prompt)
+    kv_dtype = "int8" if kv == "int8" else "native"
+    cached_s = timed(
+        lambda p: generate(
+            lm, variables, p, steps, kv_cache_dtype=kv_dtype
+        ),
+        prompt,
+    )
     cached_tok_s = batch * steps / cached_s
 
-    # Bandwidth-bound ceiling: every decode step streams all params once.
-    # Counting actual itemsize keeps the denominator honest whatever the
-    # residency above is set to (bf16 after the cast; f32 if it's ever
-    # removed).
+    # Bandwidth-bound ceiling: every decode step streams all params once
+    # PLUS the K+V cache entries. Counting actual itemsize keeps the
+    # weight term honest whatever the residency above is set to; the
+    # cache term follows the cache dtype (bf16 native here; int8 stores
+    # 1 byte/elem + one f32 scale per vector), evaluated at the padded
+    # cache length the decode attention actually streams every step.
     param_bytes = sum(
         x.size * x.dtype.itemsize for x in jax.tree.leaves(variables)
     )
-    ceiling_steps_s = TPU_V5E_HBM_BYTES_PER_S / param_bytes
+    head_dim = DIM // HEADS
+    vec_bytes = (
+        head_dim * 1 + 4 if kv_dtype == "int8" else head_dim * 2
+    )  # per K or V vector
+    cache_bytes = 2 * DEPTH * batch * HEADS * max_len * vec_bytes
+    ceiling_steps_s = TPU_V5E_HBM_BYTES_PER_S / (param_bytes + cache_bytes)
     mbu = (cached_tok_s / batch) / ceiling_steps_s
 
+    suffix = "_kv_int8" if kv_dtype == "int8" else ""
     print(
         json.dumps(
             {
-                "metric": f"lm_decode_bs{batch}_tokens_per_sec",
+                "metric": f"lm_decode_bs{batch}_tokens_per_sec{suffix}",
                 "value": round(cached_tok_s, 2),
                 "unit": "tokens/sec",
                 "vs_baseline": round(mbu, 4),
                 "baseline": "vs_baseline is MBU: measured decode steps/s "
                 f"over the HBM-bandwidth ceiling ({ceiling_steps_s:.0f} "
-                "steps/s for these param bytes at 819 GB/s)",
+                "steps/s for these param+cache bytes at 819 GB/s)",
                 "platform": jax.devices()[0].platform,
                 "device": str(jax.devices()[0]),
                 "config": f"vocab{VOCAB} d{DIM} L{DEPTH} h{HEADS} "
-                f"prompt{PROMPT_LEN} steps{steps} max_len{MAX_LEN} bf16",
+                f"prompt{prompt_len} steps{steps} max_len{max_len} bf16 "
+                f"kv={kv_dtype}",
                 "param_bytes": param_bytes,
+                "kv_cache_bytes": cache_bytes,
                 "cached_s_per_trial": round(cached_s, 4),
             }
         ),
@@ -109,15 +141,20 @@ def main() -> int:
     batch = int_flag(sys.argv, "--batch", 8)
     steps = int_flag(sys.argv, "--steps", 128)
     trials = int_flag(sys.argv, "--trials", 3)
+    prompt_len = int_flag(sys.argv, "--prompt", 64)
+    max_len = int_flag(sys.argv, "--maxlen", 256)
+    kv = str_flag(sys.argv, "--kv", "native", choices=("native", "int8"))
     if "--child" in sys.argv:
-        _child(batch, steps, trials)
+        _child(batch, steps, trials, prompt_len, max_len, kv)
         return 0
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            "--batch", str(batch), "--steps", str(steps),
-           "--trials", str(trials)]
+           "--trials", str(trials), "--prompt", str(prompt_len),
+           "--maxlen", str(max_len), "--kv", kv]
+    suffix = "_kv_int8" if kv == "int8" else ""
     return run_child_json(
         cmd,
-        metric=f"lm_decode_bs{batch}_tokens_per_sec",
+        metric=f"lm_decode_bs{batch}_tokens_per_sec{suffix}",
         unit="tokens/sec",
         timeout_s=1500,
     )
