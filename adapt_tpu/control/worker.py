@@ -283,7 +283,9 @@ class StageWorker:
                 ):
                     x = jax.device_put(task.payload, self.device)
                     y = binding.fn(binding.variables, x)
-                    y.block_until_ready()
+                    # Pytree-safe: decode-session stages return (output,
+                    # caches) tuples, not a single array.
+                    jax.block_until_ready(y)
                 self._results.put(
                     TaskResult(
                         request_id=task.request_id,

@@ -64,15 +64,6 @@ from adapt_tpu.models.transformer_lm import (
 )
 
 
-def stack_block_variables(lm: TransformerLM, variables):
-    """Per-block variable dicts -> one pytree with leading dim ``depth``
-    (the pipeline-shardable layout; blocks are structurally identical)."""
-    return jax.tree.map(
-        lambda *xs: jnp.stack(xs, axis=0),
-        *[variables[name] for name in lm.block_names],
-    )
-
-
 @dataclasses.dataclass(frozen=True)
 class PipelinedVariables:
     """Weights placed for pipelined decode: block params stacked with the

@@ -1,3 +1,4 @@
+from adapt_tpu.runtime.decode_pipeline import PipelinedDecoder
 from adapt_tpu.runtime.pipeline import LocalPipeline, ServingPipeline
 
-__all__ = ["LocalPipeline", "ServingPipeline"]
+__all__ = ["LocalPipeline", "PipelinedDecoder", "ServingPipeline"]

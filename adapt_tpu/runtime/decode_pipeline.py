@@ -1,0 +1,514 @@
+"""Fault-tolerant pipelined decode sessions: MPMD generation with replay.
+
+The SPMD ring (``parallel.pipeline_decode``) is the throughput/capacity
+path — one XLA program, no failure domain smaller than the whole mesh.
+This module is the *adaptive* counterpart, the Gen-2 star applied to
+generation: decoder stages run on :class:`~adapt_tpu.control.worker.
+StageWorker` s (device-owning executors with heartbeats, kill modes and a
+deadline watchdog — the reference ``Node``, ``/root/reference/src/
+node.py``), microbatches flow through them concurrently, and a worker
+that crashes or hangs MID-DECODE is replaced without losing the session.
+
+The hard part vs stateless serving (``runtime.pipeline.ServingPipeline``)
+is that decode stages carry *state*: each stage holds its blocks' KV
+caches, advanced one position per pass. A lost worker therefore loses
+cache state that later passes depend on. Recovery is REPLAY: committed
+tokens (every token the session has sampled) are a complete recipe for
+every stage's cache — re-run prefill plus "forced" decode passes that
+feed the known tokens and discard the logits, through the SAME jitted
+stage programs (jit cache hit, no recompile — the <2 s rebind budget,
+SURVEY.md §7.4). Exactly-once is structural: a token is appended only
+once per (microbatch, pass) by the single event loop, and results from
+a pre-recovery epoch are discarded by epoch tag (the reference's
+stale-result guard, ``src/dispatcher.py:121-151``).
+
+Scheduling: an event loop drives M microbatches through K stage workers
+(submit (m, k+1) the moment (m, k) completes; stage workers execute
+their inboxes serially), so stage k runs microbatch m while stage k-1
+runs m+1 — the reference's decoupled pump/collect
+(``src/dispatcher.py:99-119``) specialized to a token loop. Sampling
+runs host-side per pass with the same per-row-key helper the compiled
+paths use (``sample_next_tokens``), so output is token-for-token
+identical to single-program ``generate()`` (tested, including under
+mid-decode kills).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from adapt_tpu.config import FaultConfig
+from adapt_tpu.control.registry import WorkerRegistry
+from adapt_tpu.control.worker import StageWorker, Task, TaskResult
+from adapt_tpu.models.transformer_lm import (
+    TransformerLM,
+    sample_next_tokens,
+    validate_generate_args,
+)
+from adapt_tpu.utils.logging import get_logger
+from adapt_tpu.utils.metrics import global_metrics
+
+log = get_logger("decode_pipeline")
+
+
+@dataclass(frozen=True)
+class _StageProgram:
+    """One stage's two compiled entry points (shared across rebinds — a
+    replacement worker reuses the jit cache, weights move, nothing
+    recompiles)."""
+
+    index: int
+    first: bool
+    last: bool
+    block_range: tuple[int, int]
+    prefill_fn: Callable  # (vars, payload) -> (out, caches)
+    decode_fn: Callable  # (vars, (x, caches, index)) -> (out, caches)
+    variables: Any  # host master copy (rebind source)
+
+
+def _build_stage_programs(
+    lm: TransformerLM, variables, boundaries: Sequence[int]
+) -> list[_StageProgram]:
+    """Cut the decoder into stages at block ``boundaries`` (stage i runs
+    blocks [boundaries[i], boundaries[i+1])); stage 0 owns the embed,
+    the last stage owns the head."""
+    g = lm.graph
+    embed = g.node("embed").module
+    head = g.node("head").module
+    blocks = [g.node(n).module for n in lm.block_names]
+    edges = [0, *boundaries, lm.depth]
+    if any(b <= a for a, b in zip(edges, edges[1:])):
+        # Non-monotonic/out-of-range cuts would silently run blocks twice
+        # or skip them — wrong tokens with no error. Fail eagerly instead
+        # (same convention as validate_generate_args).
+        raise ValueError(
+            f"boundaries {list(boundaries)} must be strictly increasing "
+            f"within (0, {lm.depth})"
+        )
+    programs = []
+    n_stages = len(edges) - 1
+    for i in range(n_stages):
+        lo, hi = edges[i], edges[i + 1]
+        first, last = i == 0, i == n_stages - 1
+        names = lm.block_names[lo:hi]
+        stage_vars = {n: variables[n] for n in names}
+        if first:
+            stage_vars["embed"] = variables["embed"]
+        if last:
+            stage_vars["head"] = variables["head"]
+        mods = blocks[lo:hi]
+
+        def prefill_fn(svars, ids_or_h, _mods=mods, _first=first, _last=last,
+                       _names=names):
+            if _first:
+                h = embed.apply(svars["embed"], ids_or_h)
+            else:
+                h = ids_or_h
+            caches = []
+            for name, m in zip(_names, _mods):
+                h, ck, cv = m.apply(
+                    svars[name], h, lm.max_len, method="prefill"
+                )
+                caches.append((ck, cv))
+            out = (
+                head.apply(svars["head"], h[:, -1:, :])[:, 0] if _last else h
+            )
+            return out, tuple(caches)
+
+        def decode_fn(svars, payload, _mods=mods, _first=first, _last=last,
+                      _names=names):
+            x, caches, index = payload
+            if _first:
+                x = embed.apply(
+                    svars["embed"], x[:, None], index, method="embed_at"
+                )
+            new_caches = []
+            for name, m, (ck, cv) in zip(_names, _mods, caches):
+                x, ck, cv = m.apply(
+                    svars[name], x, ck, cv, index, method="decode_step"
+                )
+                new_caches.append((ck, cv))
+            out = head.apply(svars["head"], x)[:, 0] if _last else x
+            return out, tuple(new_caches)
+
+        programs.append(
+            _StageProgram(
+                index=i,
+                first=first,
+                last=last,
+                block_range=(lo, hi),
+                prefill_fn=jax.jit(prefill_fn),
+                decode_fn=jax.jit(decode_fn),
+                variables=stage_vars,
+            )
+        )
+    return programs
+
+
+#: Binding-key offset separating a stage's prefill program from its decode
+#: program on the same worker (StageWorker bindings are keyed by int).
+_PREFILL_KEY = 1000
+
+
+@dataclass
+class _MicrobatchState:
+    """Where one microbatch is in its token loop."""
+
+    prompt: Any  # this microbatch's prompt slice (replay anchor)
+    tokens: list  # committed sampled tokens, np arrays (mb,)
+    done_rows: np.ndarray  # EOS latch per row
+    caches: list  # per-stage cache pytrees (device-resident)
+    phase: str = "prefill"  # prefill | decode | finished
+    stage: int = 0  # stage currently (or next) running
+    passno: int = 0  # decode pass number (consumes token `passno`)
+    carry: Any = None  # activation flowing between stages
+
+
+class PipelinedDecoder:
+    """Adaptive multi-stage KV-cache generation over stage workers.
+
+    ``boundaries`` are block cut points (e.g. ``[2]`` splits a 4-block LM
+    into two stages of two blocks). Stage i runs on ``devices[i]``;
+    devices beyond the stage count are failover spares (a stage whose
+    worker dies rebinds to the next spare, else doubles up on a survivor).
+    """
+
+    def __init__(
+        self,
+        lm: TransformerLM,
+        variables,
+        boundaries: Sequence[int],
+        devices: Sequence[jax.Device] | None = None,
+        fault: FaultConfig | None = None,
+    ):
+        self.lm = lm
+        self.fault = fault or FaultConfig()
+        self.programs = _build_stage_programs(lm, variables, boundaries)
+        devices = list(devices if devices is not None else jax.devices())
+        if not devices:
+            raise ValueError("no devices")
+        self._spares = devices[len(self.programs):]
+        self._stage_devices = [
+            devices[i % len(devices)] for i in range(len(self.programs))
+        ]
+        self.registry = WorkerRegistry(default_ttl_s=self.fault.lease_ttl_s)
+        self.results: "queue.Queue[TaskResult]" = queue.Queue()
+        self._wid = itertools.count()
+        self._rid = itertools.count()
+        self.epoch = 0
+        self.workers: list[StageWorker] = [
+            self._spawn(i, self._stage_devices[i])
+            for i in range(len(self.programs))
+        ]
+
+    # -- workers -----------------------------------------------------------
+
+    def _spawn(self, stage: int, device: jax.Device) -> StageWorker:
+        w = StageWorker(
+            worker_id=f"decode-w{next(self._wid)}-s{stage}",
+            device=device,
+            registry=self.registry,
+            result_queue=self.results,
+            fault=self.fault,
+        ).start()
+        prog = self.programs[stage]
+        # Pre-place ONCE: configure's internal device_put then aliases the
+        # already-resident tree, so the prefill and decode bindings share
+        # one weight copy (not two — this path exists for models that
+        # press HBM limits).
+        dev_vars = jax.device_put(prog.variables, device)
+        w.configure(stage, prog.decode_fn, dev_vars)
+        w.configure(stage + _PREFILL_KEY, prog.prefill_fn, dev_vars)
+        return w
+
+    def kill_worker(self, stage: int, mode: str = "crash") -> None:
+        """Chaos hook (SURVEY.md §5): kill the worker serving a stage."""
+        self.workers[stage].kill(mode)
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            w.stop()
+
+    def __enter__(self) -> "PipelinedDecoder":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -- generation --------------------------------------------------------
+
+    def generate(
+        self,
+        prompt,
+        steps: int,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        eos_id: int | None = None,
+        rng: jax.Array | None = None,
+        num_microbatches: int | None = None,
+        on_token: Callable[[int, int], None] | None = None,
+    ) -> np.ndarray:
+        """Token-for-token ``generate()`` semantics, served through the
+        stage workers with mid-decode failover. ``on_token(m, s)`` fires
+        after microbatch ``m`` commits token ``s`` (test/chaos hook).
+        Ragged prompts and int8 caches are SPMD-path features
+        (``parallel.pipeline_decode``); this path covers the sampling
+        knobs + EOS."""
+        prompt = jnp.asarray(prompt)
+        b, s0 = prompt.shape
+        _, rng, do_sample = validate_generate_args(
+            self.lm, prompt, steps, temperature, top_k, rng, None, "native"
+        )
+        n_stages = len(self.programs)
+        # Default: as many microbatches as keep all stages busy, rounded
+        # down to a divisor of the batch.
+        M = num_microbatches or max(
+            d for d in range(1, min(b, n_stages) + 1) if b % d == 0
+        )
+        if b % M:
+            raise ValueError(f"batch {b} not divisible by {M} microbatches")
+        mb = b // M
+        temp = jnp.asarray(temperature, jnp.float32)
+        rng_next, key0 = jax.random.split(rng)
+        step_keys = [key0] + (
+            list(jax.random.split(rng_next, steps - 1)) if steps > 1 else []
+        )
+
+        states = [
+            _MicrobatchState(
+                prompt=prompt[m * mb:(m + 1) * mb],
+                tokens=[],
+                done_rows=np.zeros((mb,), bool),
+                caches=[None] * n_stages,
+                carry=prompt[m * mb:(m + 1) * mb],
+            )
+            for m in range(M)
+        ]
+        deadlines: dict[int, tuple[float, int, int]] = {}  # rid -> (t, m, stage)
+        retries = 0
+
+        def sample(m: int, logits, key):
+            st = states[m]
+            toks = np.asarray(
+                sample_next_tokens(
+                    logits, key, temp,
+                    do_sample=do_sample, top_k=top_k, row_offset=m * mb,
+                )
+            ).astype(np.asarray(prompt).dtype)
+            if eos_id is not None:
+                toks = np.where(st.done_rows, eos_id, toks)
+                st.done_rows = st.done_rows | (toks == eos_id)
+            st.tokens.append(toks)
+            if on_token is not None:
+                on_token(m, len(st.tokens) - 1)
+
+        def submit(m: int) -> None:
+            st = states[m]
+            prog = self.programs[st.stage]
+            rid = next(self._rid)
+            if st.phase == "prefill":
+                key, payload = st.stage + _PREFILL_KEY, st.carry
+            else:
+                key = st.stage
+                payload = (
+                    st.carry,
+                    st.caches[st.stage],
+                    jnp.asarray(s0 + st.passno, jnp.int32),
+                )
+            # Stage workers drain their inboxes serially, so queue wait
+            # counts toward the deadline — scale it by the tasks already
+            # ahead, or a healthy stage with a deep inbox (every
+            # microbatch bursts to stage 0 at session start) gets
+            # declared dead. task_deadline_s itself must still exceed
+            # one task's worst case incl. first-compile (FaultConfig
+            # docs).
+            depth_ahead = self.workers[st.stage].queue_depth
+            deadlines[rid] = (
+                time.monotonic()
+                + self.fault.task_deadline_s * (depth_ahead + 1),
+                m,
+                st.stage,
+            )
+            self.workers[prog.index].submit(
+                Task(
+                    request_id=rid,
+                    stage_index=key,
+                    attempt=self.epoch,
+                    payload=payload,
+                )
+            )
+
+        def advance(m: int, output, caches) -> None:
+            """One (m, stage) result: store cache, route onward."""
+            st = states[m]
+            stage = st.stage
+            st.caches[stage] = caches
+            last = stage == len(self.programs) - 1
+            if not last:
+                st.carry = output
+                st.stage += 1
+                submit(m)
+                return
+            if st.phase == "prefill":
+                sample(m, output, step_keys[0])
+                st.phase = "decode"
+                st.passno = 0
+            else:
+                sample(m, output, step_keys[st.passno + 1])
+                st.passno += 1
+            if len(st.tokens) >= steps:
+                st.phase = "finished"
+                return
+            st.stage = 0
+            st.carry = jnp.asarray(st.tokens[-1])
+            submit(m)
+
+        for m in range(M):
+            submit(m)
+
+        while any(st.phase != "finished" for st in states):
+            try:
+                res = self.results.get(timeout=self.fault.watchdog_period_s)
+            except queue.Empty:
+                res = None
+            failed_stage = None
+            if res is not None:
+                if res.attempt != self.epoch or res.request_id not in deadlines:
+                    continue  # stale epoch / already-recovered task
+                _, m, stage = deadlines.pop(res.request_id)
+                if res.error is not None:
+                    log.error(
+                        "decode stage %d failed: %s", stage, res.error
+                    )
+                    failed_stage = stage
+                else:
+                    advance(m, *res.output)
+            if failed_stage is None:
+                now = time.monotonic()
+                for _rid, (t, _m, stage) in deadlines.items():
+                    if t < now:
+                        failed_stage = stage
+                        log.warning(
+                            "decode stage %d missed its deadline "
+                            "(worker %s dead or hung)",
+                            stage,
+                            self.workers[stage].worker_id,
+                        )
+                        break
+            if failed_stage is not None:
+                retries += 1
+                if retries > self.fault.max_retries:
+                    raise RuntimeError(
+                        f"decode session failed: stage {failed_stage} "
+                        f"unrecoverable after {self.fault.max_retries} "
+                        "retries"
+                    )
+                self._recover(failed_stage, states, s0, deadlines)
+                # Re-drive every unfinished microbatch from stage 0 of its
+                # current pass (replay restored all pre-pass caches).
+                for m, st in enumerate(states):
+                    if st.phase == "finished":
+                        continue
+                    st.stage = 0
+                    if st.phase == "decode":
+                        st.carry = jnp.asarray(st.tokens[-1])
+                    else:
+                        st.carry = prompt[m * mb:(m + 1) * mb]
+                    submit(m)
+
+        out = np.stack(
+            [np.stack(st.tokens, axis=1) for st in states], axis=0
+        )  # (M, mb, steps)
+        return out.reshape(b, steps)
+
+    # -- recovery ----------------------------------------------------------
+
+    def _recover(self, stage: int, states, s0: int, deadlines) -> None:
+        """Replace the stage's worker and rebuild mid-decode microbatches'
+        caches by replaying committed tokens (prefill + forced decode
+        passes through the same jitted programs — no recompile). The
+        epoch bump invalidates every in-flight result; microbatches still
+        in prefill need no replay (the event loop re-drives their prefill
+        from scratch) and finished ones need no caches at all."""
+        t0 = time.monotonic()
+        self.epoch += 1
+        deadlines.clear()
+        dead = self.workers[stage]
+        dead.kill("crash")  # also silences a hung worker's exec loop
+        self.registry.deregister(dead.worker_id)
+        device = (
+            self._spares.pop(0)
+            if self._spares
+            else self._stage_devices[(stage + 1) % len(self._stage_devices)]
+        )
+        self._stage_devices[stage] = device
+        self.workers[stage] = self._spawn(stage, device)
+        global_metrics().inc("decode.recoveries")
+
+        def run(worker, key, payload):
+            """Synchronous replay step. The event loop is parked inside
+            _recover, so pulling self.results here is single-consumer;
+            pre-recovery stragglers are discarded by (rid, epoch) tag."""
+            rid = next(self._rid)
+            worker.submit(
+                Task(
+                    request_id=rid,
+                    stage_index=key,
+                    attempt=self.epoch,
+                    payload=payload,
+                )
+            )
+            deadline = time.monotonic() + self.fault.task_deadline_s
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(
+                        f"replay timed out on stage key {key}"
+                    )
+                try:
+                    res = self.results.get(timeout=remaining)
+                except queue.Empty:
+                    continue
+                if res.request_id != rid or res.attempt != self.epoch:
+                    continue  # pre-recovery straggler
+                if res.error is not None:
+                    raise RuntimeError(
+                        f"replay failed on stage key {key}: {res.error}"
+                    )
+                return res.output
+
+        for st in states:
+            if st.phase != "decode":
+                continue
+            # Prefill over the prompt rebuilds position-[0, s0) caches in
+            # every stage...
+            x = st.prompt
+            for k in range(len(self.programs)):
+                x, caches = run(self.workers[k], k + _PREFILL_KEY, x)
+                st.caches[k] = caches
+            # ...then forced passes replay committed tokens 0..n-2 (the
+            # last committed token is consumed by the pass the event loop
+            # re-drives after recovery).
+            for p in range(len(st.tokens) - 1):
+                x = jnp.asarray(st.tokens[p])
+                for k in range(len(self.programs)):
+                    x, caches = run(
+                        self.workers[k],
+                        k,
+                        (x, st.caches[k], jnp.asarray(s0 + p, jnp.int32)),
+                    )
+                    st.caches[k] = caches
+        log.warning(
+            "decode session recovered stage %d in %.2fs (epoch %d)",
+            stage,
+            time.monotonic() - t0,
+            self.epoch,
+        )
