@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from adapt_tpu.graph.ir import INPUT, LayerGraph
-from adapt_tpu.ops.attention import attention_reference, flash_attention
+from adapt_tpu.ops.attention import flash_attention
 
 _NEG_INF = -1e30
 
@@ -93,9 +93,10 @@ class CausalSelfAttention(nn.Module):
 
         ``valid_from`` (b,) enables ragged batches: row i's keys at
         positions < valid_from[i] are left-padding and masked out. The
-        masked variant runs the XLA oracle path — the measured dispatch
-        routes practical prompt shapes there anyway, and the Pallas
-        kernel carries no per-row key mask.
+        masked variant rides the same measured dispatch as the dense one
+        — the Pallas kernel carries the per-row mask as an SMEM scalar,
+        so a ragged long-context prefill streams instead of falling back
+        to the O(S^2) oracle.
 
         ``quantize_cache`` stores the cache int8 (one absmax scale per
         key/value vector): decode streams the whole cache from HBM every
@@ -105,12 +106,7 @@ class CausalSelfAttention(nn.Module):
         pairs."""
         b, s, d = x.shape
         q, k, v = self._project(x)
-        if valid_from is None:
-            o = flash_attention(q, k, v, causal=True)
-        else:
-            o = attention_reference(
-                q, k, v, causal=True, valid_from=valid_from
-            )
+        o = flash_attention(q, k, v, causal=True, valid_from=valid_from)
         pad = ((0, 0), (0, 0), (0, max_len - s), (0, 0))
         out = self.out(jnp.swapaxes(o, 1, 2).reshape(b, s, d))
         if quantize_cache:

@@ -97,17 +97,13 @@ def _attn_kernel(
     q_ref,
     k_ref,
     v_ref,
-    o_ref,
-    lse_ref,
-    m_scr,
-    l_scr,
-    acc_scr,
-    *,
+    *refs,
     block_k,
     num_kv,
     causal,
     sm_scale,
     valid_k,
+    has_vf=False,
 ):
     """Grid = (batch*heads, q_blocks, k_blocks); the k dimension is the
     innermost (sequential) axis, so only ONE (block_q, d) q tile and ONE
@@ -118,7 +114,17 @@ def _attn_kernel(
     which is what lets the kernel run 32k+ sequences that OOM both the
     naive full-K/V-in-VMEM layout (scoped-vmem) and XLA's materialized
     S x S scores (HBM) — measured in
-    benchmarks/results/r03/attn_longseq.json."""
+    benchmarks/results/r03/attn_longseq.json.
+
+    ``has_vf``: an extra per-(batch, head) scalar input ``vf`` (SMEM)
+    masks keys at positions < vf — ragged LEFT padding (the LM's masked
+    prefill), so ragged batches stay on the streaming path at long S
+    instead of falling back to the materialized oracle. Key blocks
+    entirely inside the padding skip their compute."""
+    if has_vf:
+        vf_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     j = pl.program_id(2)
     block_q = q_ref.shape[1]
     q_start = pl.program_id(1) * block_q
@@ -150,6 +156,10 @@ def _attn_kernel(
             # (ViT's 197 = 14^2 + CLS is the canonical offender) — mask
             # them out of the softmax like causal masks the future.
             s = jnp.where(cols < valid_k, s, _NEG_INF)
+        if has_vf:
+            # Ragged head: keys before this row's first real token are
+            # left padding.
+            s = jnp.where(cols >= vf_ref[0], s, _NEG_INF)
         if causal:
             rows = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -165,10 +175,17 @@ def _attn_kernel(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
+    # K blocks strictly after this q block (causal) or entirely inside the
+    # left padding (vf) contribute nothing — skip their compute entirely
+    # (the DMA still lands, the MXU stays idle).
+    live = None
     if causal:
-        # K blocks strictly after this q block contribute nothing — skip
-        # their compute entirely (the DMA still lands, the MXU stays idle).
-        pl.when(j * block_k <= q_start + block_q - 1)(_step)
+        live = j * block_k <= q_start + block_q - 1
+    if has_vf:
+        past_pad = (j + 1) * block_k > vf_ref[0]
+        live = past_pad if live is None else jnp.logical_and(live, past_pad)
+    if live is not None:
+        pl.when(live)(_step)
     else:
         _step()
 
@@ -198,6 +215,7 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     prefer: str | None = None,
+    valid_from: jax.Array | None = None,
 ) -> jax.Array:
     """Fused attention over (batch, heads, seq, head_dim) tensors.
 
@@ -221,6 +239,16 @@ def flash_attention(
     internal zero-padding with key masking; the only oracle fallback left
     is causal ragged-key cross-attention (s_q != s_k), where
     absolute-position masking over padded interiors is ill-defined.
+
+    ``valid_from`` (b,) masks each row's keys at positions < its value —
+    ragged LEFT padding (the LM's masked prefill). The kernel carries the
+    mask as a per-(batch, head) SMEM scalar, so ragged batches ride the
+    same measured dispatch as dense ones (kernel at long S where the
+    materialized oracle would OOM). Fully-padded query rows (position
+    < vf) have UNSPECIFIED contents — zeros when every k-block was
+    skipped, a uniform V average when the row shares a k-block with live
+    keys (which is also what the oracle emits) — no caller may read
+    them; valid rows match the oracle exactly.
     """
     if prefer is None:
         prefer = "pallas" if scores_over_budget(q.shape, k.shape) else "xla"
@@ -229,13 +257,66 @@ def flash_attention(
             f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
         )
     if prefer == "xla":
-        return attention_reference(q, k, v, causal=causal)
-    return _flash_vjp(q, k, v, causal, block_q, block_k)
+        return attention_reference(
+            q, k, v, causal=causal, valid_from=valid_from
+        )
+    if valid_from is None:
+        return _flash_vjp(q, k, v, causal, block_q, block_k)
+    return _flash_ragged_vjp(
+        q, k, v, jnp.asarray(valid_from, jnp.int32), causal, block_q,
+        block_k,
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash_vjp(q, k, v, causal, block_q, block_k):
     return _flash_impl(q, k, v, causal, block_q, block_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_ragged_vjp(q, k, v, valid_from, causal, block_q, block_k):
+    """valid_from travels as a regular (traced) operand — custom_vjp
+    nondiff_argnums may not hold tracers, and the bwd returns None for
+    its (integer, gradient-free) cotangent."""
+    return _flash_impl(
+        q, k, v, causal, block_q, block_k, valid_from=valid_from
+    )
+
+
+def _flash_ragged_fwd(q, k, v, valid_from, causal, block_q, block_k):
+    if _bwd_streams(q.shape, k.shape, causal, block_q, block_k):
+        out, lse = _flash_impl(
+            q, k, v, causal, block_q, block_k,
+            with_lse=True, valid_from=valid_from,
+        )
+        return out, (q, k, v, valid_from, out, lse)
+    out = _flash_impl(
+        q, k, v, causal, block_q, block_k, valid_from=valid_from
+    )
+    return out, (q, k, v, valid_from, None, None)
+
+
+def _flash_ragged_bwd(causal, block_q, block_k, residuals, do):
+    q, k, v, valid_from, out, lse = residuals
+    if out is None:  # materialized-recompute branch (scores fit)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: attention_reference(
+                q_, k_, v_, causal=causal, valid_from=valid_from
+            ),
+            q,
+            k,
+            v,
+        )
+        return (*vjp(do), None)
+    dq, dk, dv = _flash_bwd_impl(
+        q, k, v, out, lse, do,
+        causal=causal, block_q=block_q, block_k=block_k,
+        valid_from=valid_from,
+    )
+    return dq, dk, dv, None
+
+
+_flash_ragged_vjp.defvjp(_flash_ragged_fwd, _flash_ragged_bwd)
 
 
 def flash_attention_with_lse(
@@ -324,12 +405,15 @@ def _flash_impl(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     with_lse: bool = False,
+    valid_from: jax.Array | None = None,
 ):
     if pltpu is None:  # pragma: no cover — jax builds without pallas-tpu
         return (
-            _reference_with_lse(q, k, v, causal)
+            _reference_with_lse(q, k, v, causal, valid_from)
             if with_lse
-            else attention_reference(q, k, v, causal=causal)
+            else attention_reference(
+                q, k, v, causal=causal, valid_from=valid_from
+            )
         )
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
@@ -344,9 +428,11 @@ def _flash_impl(
     pad_k = (-s_k) % block_k
     if causal and pad_k and s_q != s_k:
         return (
-            _reference_with_lse(q, k, v, causal)
+            _reference_with_lse(q, k, v, causal, valid_from)
             if with_lse
-            else attention_reference(q, k, v, causal=causal)
+            else attention_reference(
+                q, k, v, causal=causal, valid_from=valid_from
+            )
         )
     if pad_q or pad_k:
         q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
@@ -366,6 +452,7 @@ def _flash_impl(
         causal=causal,
         sm_scale=sm_scale,
         valid_k=s_k,
+        has_vf=valid_from is not None,
     )
     on_tpu = jax.default_backend() == "tpu"
     scratch = [
@@ -373,29 +460,41 @@ def _flash_impl(
         pltpu.VMEM((block_q, 1), jnp.float32),
         pltpu.VMEM((block_q, d), jnp.float32),
     ]
+    in_specs = [
+        pl.BlockSpec(
+            (1, block_q, d),
+            lambda bh, qi, kj: (bh, qi, 0),
+            memory_space=_VMEM,
+        ),
+        pl.BlockSpec(
+            (1, block_k, d),
+            lambda bh, qi, kj: (bh, kj, 0),
+            memory_space=_VMEM,
+        ),
+        pl.BlockSpec(
+            (1, block_k, d),
+            lambda bh, qi, kj: (bh, kj, 0),
+            memory_space=_VMEM,
+        ),
+    ]
+    operands = [qf, kf, vf]
+    if valid_from is not None:
+        # Per-(batch, head) left-pad scalar rides in SMEM.
+        operands.append(
+            jnp.repeat(jnp.asarray(valid_from, jnp.int32), h)
+        )
+        in_specs.append(
+            pl.BlockSpec(
+                (1,), lambda bh, qi, kj: (bh,), memory_space=pltpu.SMEM
+            )
+        )
     out, lse = pl.pallas_call(
         kernel,
         # K/V stream one block per innermost grid step; scratch carries
         # the online-softmax state across them (TPU grids iterate
         # sequentially, innermost-fastest, so the state is coherent).
         grid=(b * h, sp_q // block_q, num_kv),
-        in_specs=[
-            pl.BlockSpec(
-                (1, block_q, d),
-                lambda bh, qi, kj: (bh, qi, 0),
-                memory_space=_VMEM,
-            ),
-            pl.BlockSpec(
-                (1, block_k, d),
-                lambda bh, qi, kj: (bh, kj, 0),
-                memory_space=_VMEM,
-            ),
-            pl.BlockSpec(
-                (1, block_k, d),
-                lambda bh, qi, kj: (bh, kj, 0),
-                memory_space=_VMEM,
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec(
                 (1, block_q, d),
@@ -421,7 +520,7 @@ def _flash_impl(
             else None
         ),
         interpret=not on_tpu,
-    )(qf, kf, vf)
+    )(*operands)
     out = out.reshape(b, h, sp_q, d)[:, :, :s_q, :]
     if not with_lse:
         return out
@@ -429,7 +528,11 @@ def _flash_impl(
 
 
 def _reference_with_lse(
-    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool,
+    valid_from: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Oracle-path ``(out, lse)`` computing the score matrix ONCE (the
     fallback exists because scores are expensive to materialize —
@@ -441,6 +544,10 @@ def _reference_with_lse(
     if causal:
         s_q, s_k = s.shape[-2:]
         s = jnp.where(jnp.tril(jnp.ones((s_q, s_k), bool)), s, _NEG_INF)
+    if valid_from is not None:
+        cols = jnp.arange(s.shape[-1])
+        live = cols[None, :] >= valid_from[:, None]
+        s = jnp.where(live[:, None, None, :], s, _NEG_INF)
     lse = jax.scipy.special.logsumexp(s, axis=-1)
     p = jnp.exp(s - lse[..., None])
     out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(
@@ -456,18 +563,21 @@ def _bwd_dq_kernel(
     do_ref,
     lse_ref,
     delta_ref,
-    dq_ref,
-    dq_scr,
-    *,
+    *refs,
     block_k,
     num_kv,
     causal,
     sm_scale,
     valid_k,
+    has_vf=False,
 ):
     """dQ pass: grid (bh, q_blocks, k_blocks), K/V streaming innermost;
     dq accumulates in VMEM scratch. Scores recompute blockwise against
     the saved row logsumexp, so nothing S x S ever exists."""
+    if has_vf:
+        vf_ref, dq_ref, dq_scr = refs
+    else:
+        dq_ref, dq_scr = refs
     j = pl.program_id(2)
     block_q = q_ref.shape[1]
     q_start = pl.program_id(1) * block_q
@@ -495,6 +605,8 @@ def _bwd_dq_kernel(
         )
         if valid_k != num_kv * block_k:
             s = jnp.where(cols < valid_k, s, _NEG_INF)
+        if has_vf:
+            s = jnp.where(cols >= vf_ref[0], s, _NEG_INF)
         if causal:
             rows = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -511,8 +623,14 @@ def _bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         )
 
+    live = None
     if causal:
-        pl.when(j * block_k <= q_start + block_q - 1)(_step)
+        live = j * block_k <= q_start + block_q - 1
+    if has_vf:
+        past_pad = (j + 1) * block_k > vf_ref[0]
+        live = past_pad if live is None else jnp.logical_and(live, past_pad)
+    if live is not None:
+        pl.when(live)(_step)
     else:
         _step()
 
@@ -528,20 +646,21 @@ def _bwd_dkv_kernel(
     do_ref,
     lse_ref,
     delta_ref,
-    dk_ref,
-    dv_ref,
-    dk_scr,
-    dv_scr,
-    *,
+    *refs,
     block_q,
     num_q,
     causal,
     sm_scale,
     valid_k,
     sp_k,
+    has_vf=False,
 ):
     """dK/dV pass: grid (bh, k_blocks, q_blocks), Q/dO streaming
     innermost; dk/dv accumulate in VMEM scratch."""
+    if has_vf:
+        vf_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = refs
     i = pl.program_id(2)
     block_k = k_ref.shape[1]
     k_start = pl.program_id(1) * block_k
@@ -571,6 +690,8 @@ def _bwd_dkv_kernel(
         )
         if valid_k != sp_k:
             s = jnp.where(cols < valid_k, s, _NEG_INF)
+        if has_vf:
+            s = jnp.where(cols >= vf_ref[0], s, _NEG_INF)
         if causal:
             rows = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -591,9 +712,16 @@ def _bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )
 
+    live = None
     if causal:
         # Q blocks entirely before this K block see none of it.
-        pl.when(q_start + block_q - 1 >= k_start)(_step)
+        live = q_start + block_q - 1 >= k_start
+    if has_vf:
+        # A K block entirely inside the left padding gets zero gradient.
+        past_pad = k_start + block_k > vf_ref[0]
+        live = past_pad if live is None else jnp.logical_and(live, past_pad)
+    if live is not None:
+        pl.when(live)(_step)
     else:
         _step()
 
@@ -606,7 +734,9 @@ def _bwd_dkv_kernel(
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k")
 )
-def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k):
+def _flash_bwd_impl(
+    q, k, v, out, lse, do, *, causal, block_q, block_k, valid_from=None
+):
     """Streaming flash backward: two Pallas passes (dQ, then dK/dV), each
     recomputing score blocks against the saved logsumexp — O(S*D) HBM
     and O(block) VMEM like the forward, so gradients survive sequence
@@ -619,6 +749,13 @@ def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k):
     block_k = min(block_k, max(s_k, 8))
     pad_q = (-s_q) % block_q
     pad_k = (-s_k) % block_k
+    if valid_from is not None:
+        # Ragged left padding: a fully-padded q row (position < vf) saved
+        # lse ~= -1e30 (everything masked); exp(s - lse) would then be
+        # exp(~0) = 1 instead of 0 and the row would pollute dK/dV. Clamp
+        # so masked scores stay masked: exp(-1e30 - (-1e20)) == 0, while
+        # any row with one live key has lse far above the clamp.
+        lse = jnp.maximum(lse, -1e20)
     # delta_i = rowsum(dO_i * O_i): the only extra residual the backward
     # needs, O(S) — computed once outside the kernels.
     delta = jnp.sum(
@@ -666,6 +803,14 @@ def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k):
     kv_spec_dq = pl.BlockSpec(
         (1, block_k, d), lambda bh, a, b_: (bh, b_, 0), memory_space=_VMEM
     )
+    vf_operands, vf_specs = [], []
+    if valid_from is not None:
+        vf_operands = [jnp.repeat(jnp.asarray(valid_from, jnp.int32), h)]
+        vf_specs = [
+            pl.BlockSpec(
+                (1,), lambda bh, a, b_: (bh,), memory_space=pltpu.SMEM
+            )
+        ]
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel,
@@ -674,15 +819,17 @@ def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k):
             causal=causal,
             sm_scale=sm_scale,
             valid_k=s_k,
+            has_vf=valid_from is not None,
         ),
         grid=(b * h, num_q, num_kv),
-        in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
+        in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec,
+                  row_spec, *vf_specs],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=params,
         interpret=not on_tpu,
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(qf, kf, vf, dof, lsef, deltaf, *vf_operands)
 
     q_spec_kv = pl.BlockSpec(
         (1, block_q, d), lambda bh, a, b_: (bh, b_, 0), memory_space=_VMEM
@@ -702,6 +849,7 @@ def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k):
             sm_scale=sm_scale,
             valid_k=s_k,
             sp_k=sp_k,
+            has_vf=valid_from is not None,
         ),
         grid=(b * h, num_kv, num_q),
         in_specs=[
@@ -711,6 +859,7 @@ def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k):
             q_spec_kv,
             row_spec_kv,
             row_spec_kv,
+            *vf_specs,
         ],
         out_specs=[kv_spec, kv_spec],
         out_shape=[
@@ -723,7 +872,7 @@ def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k):
         ],
         compiler_params=params,
         interpret=not on_tpu,
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(qf, kf, vf, dof, lsef, deltaf, *vf_operands)
 
     dq = dq.reshape(b, h, sp_q, d)[:, :, :s_q, :]
     dk = dk.reshape(b, h, sp_k, d)[:, :, :s_k, :]

@@ -12,9 +12,13 @@ are plentiful and S fits per chip; both strategies expose the same
 sharded-in/sharded-out contract, so callers pick per workload.
 
 Constraint: num_heads % axis_size == 0 (heads shard across the axis).
-The local attention defaults to the canonical oracle and accepts any
-``attn_fn(q, k, v, causal=...)`` — pass ``adapt_tpu.ops.flash_attention``
-to fuse the local block on the MXU.
+The local attention defaults to :func:`adapt_tpu.ops.attention.
+flash_attention`, whose measured dispatch (``scores_over_budget`` — the
+SAME predicate the kernel's own forward/backward and ring attention's
+``"auto"`` consult, so the three can't drift) sees the post-all-to-all
+local shape [B, H/P, S, D]: sub-budget scores run XLA's fused path,
+super-budget runs the streaming Pallas kernel. Any custom
+``attn_fn(q, k, v, causal=...)`` overrides.
 """
 
 from __future__ import annotations
@@ -42,9 +46,12 @@ def ulysses_attention(
     by the axis size; sharded on S over ``axis`` in and out.
     """
     if attn_fn is None:
-        from adapt_tpu.ops.attention import attention_reference
+        # The measured dispatch IS the default: flash_attention routes by
+        # scores_over_budget on the exact local block it will compute
+        # ([B, H/P, S, D] after the head/sequence swap).
+        from adapt_tpu.ops.attention import flash_attention
 
-        attn_fn = attention_reference
+        attn_fn = flash_attention
 
     num_ranks = mesh.shape[axis]
     _, h, s, _ = q.shape

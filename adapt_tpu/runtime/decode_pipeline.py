@@ -59,6 +59,16 @@ from adapt_tpu.utils.metrics import global_metrics
 log = get_logger("decode_pipeline")
 
 
+class _ReplayFailure(RuntimeError):
+    """A replay step failed (its worker died/hung mid-recovery); carries
+    the stage to recover next so the session's retry loop — not the
+    caller — handles cascading faults."""
+
+    def __init__(self, stage: int, message: str):
+        super().__init__(message)
+        self.stage = stage
+
+
 @dataclass(frozen=True)
 class _StageProgram:
     """One stage's two compiled entry points (shared across rebinds — a
@@ -293,7 +303,11 @@ class PipelinedDecoder:
             for m in range(M)
         ]
         deadlines: dict[int, tuple[float, int, int]] = {}  # rid -> (t, m, stage)
-        retries = 0
+        # Consecutive unrecovered faults (reset whenever any microbatch
+        # makes progress): bounds a flapping stage without capping how
+        # many *independent* faults a long session may survive.
+        consecutive_failures = 0
+        token_dtype = prompt.dtype  # hoisted: no per-token host fetch
 
         def sample(m: int, logits, key):
             st = states[m]
@@ -302,7 +316,7 @@ class PipelinedDecoder:
                     logits, key, temp,
                     do_sample=do_sample, top_k=top_k, row_offset=m * mb,
                 )
-            ).astype(np.asarray(prompt).dtype)
+            ).astype(token_dtype)
             if eos_id is not None:
                 toks = np.where(st.done_rows, eos_id, toks)
                 st.done_rows = st.done_rows | (toks == eos_id)
@@ -348,6 +362,8 @@ class PipelinedDecoder:
 
         def advance(m: int, output, caches) -> None:
             """One (m, stage) result: store cache, route onward."""
+            nonlocal consecutive_failures
+            consecutive_failures = 0
             st = states[m]
             stage = st.stage
             st.caches[stage] = caches
@@ -404,14 +420,24 @@ class PipelinedDecoder:
                         )
                         break
             if failed_stage is not None:
-                retries += 1
-                if retries > self.fault.max_retries:
-                    raise RuntimeError(
-                        f"decode session failed: stage {failed_stage} "
-                        f"unrecoverable after {self.fault.max_retries} "
-                        "retries"
-                    )
-                self._recover(failed_stage, states, s0, deadlines)
+                # A replay step can itself hit a second fault (another
+                # worker died or hung); _ReplayFailure routes that stage
+                # back here instead of aborting the session while retry
+                # budget remains.
+                while failed_stage is not None:
+                    consecutive_failures += 1
+                    if consecutive_failures > self.fault.max_retries:
+                        raise RuntimeError(
+                            f"decode session failed: stage {failed_stage} "
+                            f"unrecoverable after {self.fault.max_retries} "
+                            "consecutive retries"
+                        )
+                    try:
+                        self._recover(failed_stage, states, s0, deadlines)
+                        failed_stage = None
+                    except _ReplayFailure as e:
+                        log.error("replay hit a second fault: %s", e)
+                        failed_stage = e.stage
                 # Re-drive every unfinished microbatch from stage 0 of its
                 # current pass (replay restored all pre-pass caches).
                 for m, st in enumerate(states):
@@ -453,11 +479,18 @@ class PipelinedDecoder:
         self.workers[stage] = self._spawn(stage, device)
         global_metrics().inc("decode.recoveries")
 
-        def run(worker, key, payload):
+        def run(stage_idx, key, payload):
             """Synchronous replay step. The event loop is parked inside
             _recover, so pulling self.results here is single-consumer;
-            pre-recovery stragglers are discarded by (rid, epoch) tag."""
+            pre-recovery stragglers are discarded by (rid, epoch) tag.
+            Failures raise _ReplayFailure naming the stage so the
+            session's retry loop recovers it in turn."""
+            worker = self.workers[stage_idx]
             rid = next(self._rid)
+            # Pre-recovery tasks may still occupy this worker's inbox
+            # (their results get epoch-discarded but they DO execute) —
+            # scale the wait like submit() does.
+            depth_ahead = worker.queue_depth
             worker.submit(
                 Task(
                     request_id=rid,
@@ -466,12 +499,14 @@ class PipelinedDecoder:
                     payload=payload,
                 )
             )
-            deadline = time.monotonic() + self.fault.task_deadline_s
+            deadline = time.monotonic() + self.fault.task_deadline_s * (
+                depth_ahead + 1
+            )
             while True:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    raise RuntimeError(
-                        f"replay timed out on stage key {key}"
+                    raise _ReplayFailure(
+                        stage_idx, f"replay timed out on stage {stage_idx}"
                     )
                 try:
                     res = self.results.get(timeout=remaining)
@@ -480,8 +515,9 @@ class PipelinedDecoder:
                 if res.request_id != rid or res.attempt != self.epoch:
                     continue  # pre-recovery straggler
                 if res.error is not None:
-                    raise RuntimeError(
-                        f"replay failed on stage key {key}: {res.error}"
+                    raise _ReplayFailure(
+                        stage_idx,
+                        f"replay failed on stage {stage_idx}: {res.error}",
                     )
                 return res.output
 
@@ -492,7 +528,7 @@ class PipelinedDecoder:
             # every stage...
             x = st.prompt
             for k in range(len(self.programs)):
-                x, caches = run(self.workers[k], k + _PREFILL_KEY, x)
+                x, caches = run(k, k + _PREFILL_KEY, x)
                 st.caches[k] = caches
             # ...then forced passes replay committed tokens 0..n-2 (the
             # last committed token is consumed by the pass the event loop
@@ -501,7 +537,7 @@ class PipelinedDecoder:
                 x = jnp.asarray(st.tokens[p])
                 for k in range(len(self.programs)):
                     x, caches = run(
-                        self.workers[k],
+                        k,
                         k,
                         (x, st.caches[k], jnp.asarray(s0 + p, jnp.int32)),
                     )

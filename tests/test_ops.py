@@ -261,3 +261,103 @@ def test_flash_attention_backward_dispatch(monkeypatch):
         lambda q: jnp.sum(A.flash_attention(q, q, q, prefer="pallas"))
     )(q)
     assert called
+
+
+@pytest.mark.parametrize(
+    "b,h,s,d,causal",
+    [
+        (3, 2, 256, 32, True),  # block-divisible, the LM prefill shape class
+        (3, 2, 256, 32, False),
+        (2, 2, 197, 16, True),  # ragged tail AND ragged head together
+    ],
+)
+def test_flash_attention_valid_from_matches_oracle(b, h, s, d, causal):
+    """Per-row left-padding (valid_from) inside the kernel must match the
+    masked oracle on every VALID query row. Fully-padded rows (position
+    < vf) are unspecified — zeros if every k-block was skipped, a
+    uniform average if the row shares a k-block with live keys — and no
+    caller reads them (the LM masks those positions out of every
+    downstream attention window)."""
+    q = jax.random.normal(jax.random.PRNGKey(7), (b, h, s, d))
+    k = jax.random.normal(jax.random.PRNGKey(8), (b, h, s, d))
+    v = jax.random.normal(jax.random.PRNGKey(9), (b, h, s, d))
+    vf = jnp.asarray([0, s // 3, min(s - 1, 200)][:b], jnp.int32)
+    out = flash_attention(
+        q, k, v, causal=causal, valid_from=vf, prefer="pallas"
+    )
+    ref = attention_reference(q, k, v, causal=causal, valid_from=vf)
+    rows_valid = jnp.arange(s)[None, :] >= vf[:, None]  # (b, s)
+    mask = rows_valid[:, None, :, None]
+    np.testing.assert_allclose(
+        np.asarray(jnp.where(mask, out, 0.0)),
+        np.asarray(jnp.where(mask, ref, 0.0)),
+        rtol=5e-3,
+        atol=5e-3,
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_valid_from_streaming_backward(causal, monkeypatch):
+    """Gradients through the vf-masked streaming backward match the
+    masked oracle when the loss reads only valid rows (the only contract
+    any ragged caller relies on). Budget patched to 0 so the small shape
+    exercises the streaming kernels."""
+    import adapt_tpu.ops.attention as A
+
+    monkeypatch.setattr(A, "FLASH_SCORE_BYTES_BUDGET", 0)
+    b, h, s, d = 2, 2, 128, 16
+    q = jax.random.normal(jax.random.PRNGKey(10), (b, h, s, d))
+    k = jax.random.normal(jax.random.PRNGKey(11), (b, h, s, d))
+    v = jax.random.normal(jax.random.PRNGKey(12), (b, h, s, d))
+    vf = jnp.asarray([5, 40], jnp.int32)
+    row_mask = (jnp.arange(s)[None, :] >= vf[:, None])[:, None, :, None]
+
+    def loss_flash(q, k, v):
+        o = A.flash_attention(
+            q, k, v, causal=causal, valid_from=vf, prefer="pallas"
+        )
+        return jnp.sum(jnp.where(row_mask, jnp.sin(o), 0.0))
+
+    def loss_ref(q, k, v):
+        o = attention_reference(q, k, v, causal=causal, valid_from=vf)
+        return jnp.sum(jnp.where(row_mask, jnp.sin(o), 0.0))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf),
+            np.asarray(gr),
+            rtol=2e-4,
+            atol=2e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_ragged_prefill_routes_through_measured_dispatch(monkeypatch):
+    """prefill(valid_from=...) no longer hardcodes the oracle: past the
+    budget it runs the vf-masked kernel (here: budget patched to 0 and
+    the kernel entry instrumented)."""
+    import adapt_tpu.ops.attention as A
+    from adapt_tpu.models.transformer_lm import lm_tiny
+
+    lm = lm_tiny(vocab=31, max_len=32)
+    prompt = jax.random.randint(jax.random.PRNGKey(13), (2, 8), 0, 31)
+    variables = lm.graph.init(jax.random.PRNGKey(14), prompt)
+
+    calls = []
+    real = A._flash_impl
+    monkeypatch.setattr(
+        A,
+        "_flash_impl",
+        lambda *a, **kw: calls.append(kw.get("valid_from") is not None)
+        or real(*a, **kw),
+    )
+    monkeypatch.setattr(A, "FLASH_SCORE_BYTES_BUDGET", 0)
+    from adapt_tpu.models.transformer_lm import generate
+
+    generate(
+        lm, variables, prompt, 2, prompt_lengths=jnp.asarray([3, 8])
+    )
+    # One vf-masked kernel call per decoder block, no dense/oracle calls.
+    assert calls == [True] * lm.depth, calls
