@@ -23,6 +23,7 @@ cut link expires the lease exactly like a crashed in-process worker.
 
 from __future__ import annotations
 
+import hmac
 import itertools
 import json
 import socket
@@ -167,7 +168,11 @@ class RemoteStageServer:
         self._stages[idx] = (fn, variables)
         self._codec = codec_lib.get_codec(cfg.get("codec", "none"))
 
-    def _handle(self, conn: socket.socket) -> None:
+    def _handle(self, conn: socket.socket) -> int:
+        """Serve one connection until it closes; returns the number of
+        messages processed (0 = the peer closed before saying anything —
+        the shape of a gateway join rejection)."""
+        n_msgs = 0
         stop_ping = threading.Event()
         # The ping thread and the serve loop both write this connection;
         # without a lock a ping frame can land inside a partially-sent
@@ -196,6 +201,7 @@ class RemoteStageServer:
         try:
             while not self._crashed:
                 msg = recv_msg(conn)
+                n_msgs += 1
                 if pending:
                     # Purge abandoned configures on every message: an
                     # aborted mid-stream configure whose UNCONFIGURE also
@@ -311,6 +317,7 @@ class RemoteStageServer:
         finally:
             stop_ping.set()
             conn.close()
+        return n_msgs
 
     def _execute(self, reply, msg: Message) -> None:
         try:
@@ -357,36 +364,72 @@ class RemoteStageServer:
         srv.close()
 
     def connect_and_serve(
-        self, address: tuple[str, int], worker_id: str, retries: int = 20
+        self,
+        address: tuple[str, int],
+        worker_id: str,
+        retries: int = 20,
+        secret: str | None = None,
     ) -> None:
         """Worker-initiated join: dial the dispatcher's WorkerGateway,
         announce ourselves, then serve the connection. The TPU-native
         re-expression of the reference worker self-registering in etcd
         (``/root/reference/src/node_state.py:17-20``) — here the dial +
         MSG_HELLO *is* the registration write, and the gateway-side lease
-        renewal rides the same connection's pings."""
-        last: Exception | None = None
-        for _ in range(retries):
-            try:
-                conn = socket.create_connection(address, timeout=5.0)
-                break
-            except OSError as e:
-                last = e
-                time.sleep(0.25)
-        else:
-            raise ConnectionError(
-                f"cannot reach gateway at {address}: {last}"
+        renewal rides the same connection's pings. ``secret`` (if the
+        gateway requires one) rides in the HELLO; a rejected join shows
+        up as the gateway closing the link before any message.
+
+        Joins RETRY (``join_retries``, 1 s apart): the legitimate rejoin
+        race is a worker redialing after a link blip while the gateway's
+        stale proxy for the SAME worker_id has not yet noticed its dead
+        socket — the duplicate-live-id guard rejects the first attempt,
+        the stale proxy deregisters within a ping interval, and the next
+        attempt lands. A genuine rejection (bad secret, true duplicate)
+        exhausts the budget and raises."""
+        join_retries = 8
+        for join_attempt in range(join_retries):
+            last: Exception | None = None
+            for _ in range(retries):
+                try:
+                    conn = socket.create_connection(address, timeout=5.0)
+                    break
+                except OSError as e:
+                    last = e
+                    time.sleep(0.25)
+            else:
+                raise ConnectionError(
+                    f"cannot reach gateway at {address}: {last}"
+                )
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # create_connection's 5 s dial timeout must NOT linger on the
+            # serving socket: a timed-out mid-frame result send would
+            # desync the stream and a slow ping send would kill the
+            # heartbeat thread. Serving uses blocking sends, like the
+            # dial-in accept path.
+            conn.settimeout(None)
+            info = {"worker_id": worker_id}
+            if secret is not None:
+                info["secret"] = secret
+            send_msg(
+                conn, Message(MSG_HELLO, 0, 0, 0, json.dumps(info).encode())
             )
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        # create_connection's 5 s dial timeout must NOT linger on the
-        # serving socket: a timed-out mid-frame result send would desync
-        # the stream and a slow ping send would kill the heartbeat thread.
-        # Serving uses blocking sends, like the dial-in accept path.
-        conn.settimeout(None)
-        hello = json.dumps({"worker_id": worker_id}).encode()
-        send_msg(conn, Message(MSG_HELLO, 0, 0, 0, hello))
-        log.info("joined gateway %s:%d as %s", *address, worker_id)
-        self._handle(conn)
+            log.info("dialed gateway %s:%d as %s", *address, worker_id)
+            if self._handle(conn) > 0 or self._crashed:
+                # A real session ran (or we were killed through it);
+                # done. A later link drop is the gateway proxy's problem.
+                return
+            log.warning(
+                "gateway closed the join as %s without serving "
+                "(rejected or stale-duplicate race), attempt %d/%d",
+                worker_id,
+                join_attempt + 1,
+                join_retries,
+            )
+            time.sleep(1.0)
+        raise ConnectionError(
+            f"gateway refused join as {worker_id!r} "
+            f"after {join_retries} attempts"
+        )
 
 
 # --------------------------------------------------------------------------
@@ -802,7 +845,15 @@ class WorkerGateway:
 
     Codec routing: the activation and weights codecs come from the
     dispatcher's ``ServeConfig.codec`` — the one knob configures every
-    worker that joins."""
+    worker that joins.
+
+    Hardening (above reference parity — the reference has no auth
+    anywhere, SURVEY.md §2.8): a joiner announcing a ``worker_id`` that
+    is currently LIVE is rejected (it would race the live proxy's lease
+    and confuse result routing; lease tokens protect eviction, not
+    identity), and an optional ``secret`` must match the HELLO's
+    (constant-time compare) — closing the open-port spoof when the
+    gateway listens beyond localhost."""
 
     def __init__(
         self,
@@ -810,9 +861,11 @@ class WorkerGateway:
         model_config: dict,
         host: str = "127.0.0.1",
         port: int = 0,
+        secret: str | None = None,
     ):
         self._dispatcher = dispatcher
         self._model_config = model_config
+        self._secret = secret
         codec_cfg = dispatcher.config.codec
         self._codec_name = codec_cfg.name
         self._weights_codec = codec_cfg.weights
@@ -880,6 +933,21 @@ class WorkerGateway:
                     )
                 info = json.loads(msg.payload.decode())
                 worker_id = info["worker_id"]
+                if self._secret is not None and not hmac.compare_digest(
+                    str(info.get("secret", "")), self._secret
+                ):
+                    raise ValueError(
+                        "join rejected: bad or missing gateway secret"
+                    )
+                if worker_id in self._dispatcher.registry.alive():
+                    # A live duplicate would race the existing proxy's
+                    # lease and interleave two links' results under one
+                    # identity. (A JOINER replacing its own dead link is
+                    # fine: the dead proxy deregistered on link close.)
+                    raise ValueError(
+                        f"join rejected: worker_id {worker_id!r} is "
+                        "currently live"
+                    )
                 proxy = RemoteWorkerProxy(
                     worker_id,
                     addr,
@@ -936,6 +1004,11 @@ def main() -> None:
     p.add_argument("--device-index", type=int, default=0)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--heartbeat", type=float, default=0.5)
+    p.add_argument(
+        "--secret",
+        default=os.environ.get("ADAPT_TPU_GATEWAY_SECRET"),
+        help="gateway join secret (or env ADAPT_TPU_GATEWAY_SECRET)",
+    )
     args = p.parse_args()
     if (args.port is None) == (args.connect is None):
         p.error("exactly one of --port / --connect is required")
@@ -948,7 +1021,9 @@ def main() -> None:
     if args.connect is not None:
         host, _, port = args.connect.rpartition(":")
         worker_id = args.worker_id or f"remote-{os.getpid()}"
-        server.connect_and_serve((host, int(port)), worker_id)
+        server.connect_and_serve(
+            (host, int(port)), worker_id, secret=args.secret
+        )
     else:
         server.serve_forever()
 

@@ -105,6 +105,7 @@ class StageWorker:
         self._state = WorkerState.IDLE
         self._state_lock = threading.Lock()
         self._crashed = threading.Event()
+        self._stopping = threading.Event()  # clean stop() vs crash
         self._hung = threading.Event()
         self._threads: list[threading.Thread] = []
 
@@ -128,6 +129,7 @@ class StageWorker:
         return self
 
     def stop(self) -> None:
+        self._stopping.set()
         self._crashed.set()
         self._inbox.put(None)
         for t in self._threads:
@@ -233,10 +235,14 @@ class StageWorker:
             renewed = self._registry.heartbeat(
                 self.worker_id, ttl_s=self._fault.lease_ttl_s
             )
-            if not renewed:
+            if not renewed and not self._crashed.is_set():
                 # Lease lapsed (e.g. a long compile stalled this thread)
                 # but we are alive: re-register rather than serve forever
-                # while invisible to the scheduler.
+                # while invisible to the scheduler. The crash re-check
+                # closes the race with the exec loop's crash-eviction
+                # deregister — without it, a heartbeat in flight during
+                # the kill could resurrect the dead worker's lease for a
+                # full TTL.
                 self._registry.register(
                     self.worker_id,
                     meta={"device": str(self.device)},
@@ -244,6 +250,27 @@ class StageWorker:
                 )
 
     def _exec_loop(self) -> None:
+        try:
+            self._exec_loop_inner()
+        finally:
+            if self._crashed.is_set() and not self._stopping.is_set():
+                # Event-driven crash eviction: an in-process worker whose
+                # exec loop died is gone NOW — deregister instead of
+                # letting membership wait out the lease TTL. The
+                # reference evicts on socket error, not timeout
+                # (src/dispatcher.py:153-161), and the cross-host path
+                # here already deregisters when the link closes
+                # (comm/remote.py); this is the local equivalent. A hang
+                # keeps its lease by design — only the watchdog can call
+                # that.
+                self._registry.deregister(self.worker_id)
+                global_metrics().inc("worker.crash_evicted")
+                log.warning(
+                    "worker %s evicted on crash (event, not TTL)",
+                    self.worker_id,
+                )
+
+    def _exec_loop_inner(self) -> None:
         while not self._crashed.is_set():
             task = self._inbox.get()
             if task is None or self._crashed.is_set():

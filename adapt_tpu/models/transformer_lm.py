@@ -119,15 +119,31 @@ class CausalSelfAttention(nn.Module):
             )
         return out, jnp.pad(k, pad), jnp.pad(v, pad)
 
+    @staticmethod
+    def _cache_write(cache, new, index):
+        """Write one token's K or V at ``index``: a scalar index updates
+        the whole batch at one position (the generate() lockstep), a
+        (b,) index writes each ROW at its own position — what continuous
+        batching needs, where every slot is at a different sequence
+        length. The per-row form is a vmapped dynamic_update_slice (one
+        fused scatter under XLA, not b copies)."""
+        if jnp.ndim(index):
+            return jax.vmap(
+                lambda c, n, i: lax.dynamic_update_slice(c, n, (0, i, 0))
+            )(cache, new, index)
+        return lax.dynamic_update_slice(cache, new, (0, 0, index, 0))
+
     def decode_step(
         self, x_t, cache_k, cache_v, index, valid_from=None, quantized=False
     ):
         """One token: write its K/V at ``index``, attend its q over the
         cache. ``index`` is traced — the same compiled step serves every
-        position. ``valid_from`` (b,) masks a ragged batch's left
-        padding out of the cache window. ``quantized`` caches are
-        ``(int8 values, f32 scales)`` pairs (see ``prefill``); the
-        dequantize multiplies fuse into the attention matmuls."""
+        position — and may be scalar (whole batch in lockstep) or (b,)
+        (each row at its own position; see ``_cache_write``).
+        ``valid_from`` (b,) masks a ragged batch's left padding out of
+        the cache window. ``quantized`` caches are ``(int8 values, f32
+        scales)`` pairs (see ``prefill``); the dequantize multiplies
+        fuse into the attention matmuls."""
         b = x_t.shape[0]
         q, k, v = self._project(x_t)  # each (b, h, 1, hd)
         sm = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
@@ -135,10 +151,10 @@ class CausalSelfAttention(nn.Module):
             (kvl, ksc), (vvl, vsc) = cache_k, cache_v
             nk, nks = self._quantize_kv(k)
             nv, nvs = self._quantize_kv(v)
-            kvl = lax.dynamic_update_slice(kvl, nk, (0, 0, index, 0))
-            ksc = lax.dynamic_update_slice(ksc, nks, (0, 0, index, 0))
-            vvl = lax.dynamic_update_slice(vvl, nv, (0, 0, index, 0))
-            vsc = lax.dynamic_update_slice(vsc, nvs, (0, 0, index, 0))
+            kvl = self._cache_write(kvl, nk, index)
+            ksc = self._cache_write(ksc, nks, index)
+            vvl = self._cache_write(vvl, nv, index)
+            vsc = self._cache_write(vsc, nvs, index)
             cache_k, cache_v = (kvl, ksc), (vvl, vsc)
             # Per-vector scales factor exactly OUT of the dots: apply
             # them to the small (b, h, 1, L) score/probability rows, so
@@ -152,8 +168,8 @@ class CausalSelfAttention(nn.Module):
             ) * jnp.swapaxes(ksc, 2, 3) * sm  # (b, h, 1, L)
             n_pos = kvl.shape[2]
         else:
-            cache_k = lax.dynamic_update_slice(cache_k, k, (0, 0, index, 0))
-            cache_v = lax.dynamic_update_slice(cache_v, v, (0, 0, index, 0))
+            cache_k = self._cache_write(cache_k, k, index)
+            cache_v = self._cache_write(cache_v, v, index)
             s = (
                 jnp.einsum(
                     "bhqd,bhkd->bhqk",
@@ -164,7 +180,9 @@ class CausalSelfAttention(nn.Module):
             )  # (b, h, 1, max_len)
             n_pos = cache_k.shape[2]
         positions = jnp.arange(n_pos)
-        live = positions[None, :] <= index
+        live = positions[None, :] <= (
+            index[:, None] if jnp.ndim(index) else index
+        )
         if valid_from is not None:
             live = live & (positions[None, :] >= valid_from[:, None])
         s = jnp.where(live[:, None, None, :], s, _NEG_INF)

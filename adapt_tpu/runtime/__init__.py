@@ -1,4 +1,10 @@
+from adapt_tpu.runtime.continuous import ContinuousBatcher
 from adapt_tpu.runtime.decode_pipeline import PipelinedDecoder
 from adapt_tpu.runtime.pipeline import LocalPipeline, ServingPipeline
 
-__all__ = ["LocalPipeline", "PipelinedDecoder", "ServingPipeline"]
+__all__ = [
+    "ContinuousBatcher",
+    "LocalPipeline",
+    "PipelinedDecoder",
+    "ServingPipeline",
+]

@@ -231,6 +231,7 @@ class ServingPipeline:
         gateway_model_config: dict | None = None,
         gateway_host: str = "127.0.0.1",
         gateway_port: int = 0,
+        gateway_secret: str | None = None,
     ):
         devices = list(devices if devices is not None else jax.devices())
         self.config = config or ServeConfig()
@@ -250,6 +251,7 @@ class ServingPipeline:
                 gateway_model_config,
                 host=gateway_host,
                 port=gateway_port,
+                secret=gateway_secret,
             )
 
     @property

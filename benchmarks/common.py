@@ -58,14 +58,18 @@ def emit(
 
 
 def measure_scan_throughput(
-    graph, x0, iters: int, trials: int
+    graph, x0, iters: int, trials: int, param_dtype: str | None = None
 ) -> tuple[float, list[float]]:
     """The one honest timed region for this image (shared by ``bench.py``,
     ``local_infer.py`` and ``tpu_models.py``): ITERS forward passes of
     ``graph`` inside one jitted ``lax.scan`` whose carry makes every
     iteration data-dependent on the last (defeats LICM and the tunnel's
     (fn, args) dedup), timed around a host fetch. Returns
-    (images_per_sec, per-trial wall seconds)."""
+    (images_per_sec, per-trial wall seconds).
+
+    ``param_dtype="bfloat16"`` makes weights bf16-RESIDENT (flax keeps
+    params f32 by default and casts per use — residency halves the
+    weight bytes every iteration streams from HBM)."""
     import statistics
     import time
 
@@ -75,6 +79,14 @@ def measure_scan_throughput(
     from jax import lax
 
     variables = jax.jit(graph.init)(jax.random.PRNGKey(0), x0)
+    if param_dtype is not None:
+        target = jnp.dtype(param_dtype)
+        variables = jax.tree.map(
+            lambda x: x.astype(target)
+            if x.dtype == jnp.float32
+            else x,
+            variables,
+        )
 
     def bench_fn(variables, x):
         def body(x, _):

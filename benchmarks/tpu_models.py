@@ -45,7 +45,8 @@ MODELS = {
 
 
 def _child(
-    model: str, batch: int, iters: int, trials: int, attn: str | None
+    model: str, batch: int, iters: int, trials: int, attn: str | None,
+    resident: str | None,
 ) -> None:
     import jax
     import jax.numpy as jnp
@@ -60,10 +61,14 @@ def _child(
     x0 = jax.random.normal(
         jax.random.PRNGKey(0), (batch, h, w, c), jnp.float32
     )
-    images_per_sec, times = measure_scan_throughput(graph, x0, iters, trials)
+    images_per_sec, times = measure_scan_throughput(
+        graph, x0, iters, trials,
+        param_dtype="bfloat16" if resident == "bf16" else None,
+    )
     record = {
         "metric": f"{model}_bs{batch}_images_per_sec_per_chip"
-        + (f"_attn_{attn}" if attn else ""),
+        + (f"_attn_{attn}" if attn else "")
+        + (f"_res_{resident}" if resident else ""),
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / a100, 4),
@@ -99,6 +104,9 @@ def main() -> int:
     # End-to-end attention A/B knob (vit only): force "pallas" or "xla";
     # default "" follows ops.attention's measured dispatch.
     attn = str_flag(sys.argv, "--attn", "", choices=("", "pallas", "xla"))
+    # bf16-RESIDENT weights (vs flax's default f32 residency + per-use
+    # cast): halves the weight bytes each iteration streams.
+    resident = str_flag(sys.argv, "--resident", "", choices=("", "bf16"))
     if attn and model != "vit_b16":
         print(json.dumps({"metric": f"{model}_bs{batch}_images_per_sec_per_chip"
                                     f"_attn_{attn}",
@@ -108,7 +116,7 @@ def main() -> int:
                                    "(the other models have no attention)"}))
         return 0
     if "--child" in sys.argv:
-        _child(model, batch, iters, trials, attn or None)
+        _child(model, batch, iters, trials, attn or None, resident or None)
         return 0
 
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
@@ -116,12 +124,15 @@ def main() -> int:
            "--iters", str(iters), "--trials", str(trials)]
     if attn:
         cmd += ["--attn", attn]
+    if resident:
+        cmd += ["--resident", resident]
     return run_child_json(
         cmd,
-        # Same suffix the child uses on success, so a failed --attn A/B run
+        # Same suffixes the child uses on success, so a failed A/B run
         # emits its error row under the A/B metric, never the baseline's.
         metric=f"{model}_bs{batch}_images_per_sec_per_chip"
-        + (f"_attn_{attn}" if attn else ""),
+        + (f"_attn_{attn}" if attn else "")
+        + (f"_res_{resident}" if resident else ""),
         unit="images/sec",
         timeout_s=900,
     )

@@ -590,3 +590,98 @@ def test_serving_pipeline_elastic_gateway(devices):
             proc.terminate()
             proc.wait(timeout=10)
         pipe.shutdown()
+
+
+def _raw_hello(port: int, worker_id: str, secret: str | None = None):
+    """Dial a gateway and send a bare HELLO; returns ("ack", None) on
+    acceptance, ("rejected", reason) when the gateway closes the link
+    before saying anything. Acceptance = ANY message arrives: the
+    dispatcher's join-watch prewarm can put a MSG_CONFIG on the wire
+    before the gateway's HELLO_ACK (they race by design)."""
+    import json as _json
+
+    from adapt_tpu.comm.remote import MSG_HELLO
+
+    info = {"worker_id": worker_id}
+    if secret is not None:
+        info["secret"] = secret
+    conn = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+    send_msg(conn, Message(MSG_HELLO, 0, 0, 0, _json.dumps(info).encode()))
+    conn.settimeout(5.0)
+    try:
+        recv_msg(conn, retry_on_timeout=False)
+    except Exception as e:  # noqa: BLE001 — closed link == rejection
+        conn.close()
+        return "rejected", str(e)
+    # Accepted: hand the OPEN socket back — closing it would make the
+    # gateway proxy deregister the lease (link-drop eviction) before the
+    # caller can observe it.
+    return "ack", conn
+
+
+def test_gateway_rejects_duplicate_live_worker_id_and_bad_secret(devices):
+    """Gateway hardening (above reference parity — the reference has no
+    auth anywhere, SURVEY.md §2.8): a joiner announcing a LIVE worker's
+    id is rejected (it would race that worker's lease and interleave two
+    links under one identity), and when the gateway carries a secret, a
+    join without the matching one is rejected (constant-time compare)."""
+    from adapt_tpu.comm.remote import WorkerGateway
+    from adapt_tpu.config import FaultConfig, ServeConfig
+    from adapt_tpu.control.dispatcher import Dispatcher
+    from adapt_tpu.graph import partition
+    from adapt_tpu.models.vit import vit_tiny
+
+    g = vit_tiny()
+    x = jnp.ones((1, 32, 32, 3), jnp.float32)
+    variables = g.init(jax.random.PRNGKey(0), x)
+    plan = partition(g, ["encoder_block_1"])
+    cfg = ServeConfig(
+        fault=FaultConfig(
+            lease_ttl_s=2.0, heartbeat_s=0.2, startup_wait_s=10.0
+        )
+    )
+    disp = Dispatcher(plan, variables, config=cfg)
+    local = disp.spawn_workers(devices[:2])
+    gateway = WorkerGateway(
+        disp,
+        model_config={"model": "vit_tiny", "num_classes": 10,
+                      "cuts": ["encoder_block_1"],
+                      "input_shape": [1, 32, 32, 3]},
+        secret="open-sesame",
+    )
+    try:
+        disp.start()
+        gateway.start()
+        live_id = local[0].worker_id
+        assert live_id in disp.registry.alive()
+
+        # No secret / wrong secret: closed before any attach.
+        assert _raw_hello(gateway.port, "mallory")[0] == "rejected"
+        assert (
+            _raw_hello(gateway.port, "mallory", secret="guess")[0]
+            == "rejected"
+        )
+        assert "mallory" not in disp.registry.alive()
+
+        # Right secret but a LIVE worker's id: rejected, live lease
+        # untouched.
+        status, _ = _raw_hello(gateway.port, live_id, secret="open-sesame")
+        assert status == "rejected"
+        assert live_id in disp.registry.alive()
+
+        # Right secret, fresh id: accepted (message flows + lease
+        # registered while the link stays open).
+        status, conn = _raw_hello(
+            gateway.port, "joiner-x", secret="open-sesame"
+        )
+        assert status == "ack"
+        try:
+            deadline = time.monotonic() + 10.0
+            while "joiner-x" not in disp.registry.alive():
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+        finally:
+            conn.close()
+    finally:
+        gateway.stop()
+        disp.shutdown()

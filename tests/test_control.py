@@ -552,3 +552,45 @@ def test_local_pipeline_from_config_codec_hop(small_model, devices):
     np.testing.assert_allclose(
         np.asarray(pipe_none.infer(x)), exact, rtol=1e-6
     )
+
+
+def test_crash_eviction_is_event_driven_hang_is_not(devices):
+    """A crashed worker's exec loop deregisters it IMMEDIATELY (the
+    reference evicts on socket error, not timeout, dispatcher.py:153-161)
+    — the lease TTL is only the backstop for event-less deaths. A hung
+    worker keeps heartbeating and MUST keep its lease: only the task
+    watchdog may call that failure."""
+    import queue as _queue
+    import time as _time
+
+    from adapt_tpu.config import FaultConfig
+    from adapt_tpu.control.registry import WorkerRegistry
+    from adapt_tpu.control.worker import StageWorker
+
+    # TTL deliberately huge: any eviction within the assert window must
+    # have come from the crash event, not expiry.
+    fault = FaultConfig(lease_ttl_s=60.0, heartbeat_s=0.05)
+    registry = WorkerRegistry(default_ttl_s=60.0)
+    rq: "_queue.Queue" = _queue.Queue()
+    crash_w = StageWorker("ev-crash", devices[0], registry, rq, fault).start()
+    hang_w = StageWorker("ev-hang", devices[1], registry, rq, fault).start()
+    try:
+        assert set(registry.alive()) >= {"ev-crash", "ev-hang"}
+        t0 = _time.monotonic()
+        crash_w.kill("crash")
+        hang_w.kill("hang")
+        while "ev-crash" in registry.alive():
+            assert _time.monotonic() - t0 < 2.0, (
+                "crash eviction waited on something other than the event"
+            )
+            _time.sleep(0.005)
+        detect_s = _time.monotonic() - t0
+        assert detect_s < 1.0, f"event-driven eviction took {detect_s:.2f}s"
+        _time.sleep(0.2)
+        assert "ev-hang" in registry.alive(), (
+            "a hang must not be evicted from membership (it heartbeats; "
+            "only the watchdog may catch it)"
+        )
+    finally:
+        hang_w.stop()
+        registry.close() if hasattr(registry, "close") else None
