@@ -238,16 +238,21 @@ class StageWorker:
             if not renewed and not self._crashed.is_set():
                 # Lease lapsed (e.g. a long compile stalled this thread)
                 # but we are alive: re-register rather than serve forever
-                # while invisible to the scheduler. The crash re-check
-                # closes the race with the exec loop's crash-eviction
-                # deregister — without it, a heartbeat in flight during
-                # the kill could resurrect the dead worker's lease for a
-                # full TTL.
+                # while invisible to the scheduler.
                 self._registry.register(
                     self.worker_id,
                     meta={"device": str(self.device)},
                     ttl_s=self._fault.lease_ttl_s,
                 )
+                if self._crashed.is_set():
+                    # Check-then-act race with the exec loop's
+                    # crash-eviction deregister: if the kill landed
+                    # between our pre-check and the register above, the
+                    # eviction may already have run and our register
+                    # just resurrected a dead worker's lease. The
+                    # post-register re-check closes every interleaving:
+                    # whichever side runs last removes the lease.
+                    self._registry.deregister(self.worker_id)
 
     def _exec_loop(self) -> None:
         try:

@@ -37,9 +37,13 @@ serving, TPU-first:
   would have emitted for it alone — tested with staggered arrivals and
   mixed greedy/sampled traffic. Slot scheduling is invisible in outputs.
 
+``kv_cache_dtype="int8"`` stores slot caches quantized (absmax per K/V
+vector, the same scheme as ``generate``): ~2x the resident context per
+slot and ~2x less per-step cache traffic vs bf16 caches.
+
 Not in scope (v1): per-request top_k (it is a static shape — one value
-per batcher), int8 slot caches, and cross-chip slots (compose with the
-pipelined decoders for models bigger than one chip).
+per batcher) and cross-chip slots (compose with the pipelined decoders
+for models bigger than one chip).
 """
 
 from __future__ import annotations
@@ -99,6 +103,7 @@ class ContinuousBatcher:
         top_k: int | None = None,
         prompt_buckets: tuple[int, ...] | None = None,
         chunk: int = 8,
+        kv_cache_dtype: str = "native",
     ):
         self.lm = lm
         self.variables = variables
@@ -107,6 +112,15 @@ class ContinuousBatcher:
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         self.chunk = chunk
+        if kv_cache_dtype not in ("native", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype={kv_cache_dtype!r}: expected 'native' "
+                "or 'int8'"
+            )
+        #: int8 slot caches: absmax per K/V vector, same scheme as
+        #: generate(kv_cache_dtype="int8") — ~2x more resident context
+        #: per slot and ~2x less per-step cache traffic vs bf16.
+        self._kv_quant = kv_cache_dtype == "int8"
         if top_k is not None and not (1 <= top_k <= lm.vocab):
             raise ValueError(f"top_k {top_k} outside [1, {lm.vocab}]")
         if prompt_buckets is None:
@@ -124,15 +138,20 @@ class ContinuousBatcher:
         self._cache_len = lm.max_len + 1  # one trash slot for idle rows
         self._trash = lm.max_len
         heads, head_dim = block0.heads, block0.dim // block0.heads
-        self._caches = [
-            (
-                jnp.zeros((slots, heads, self._cache_len, head_dim),
-                          block0.dtype),
-                jnp.zeros((slots, heads, self._cache_len, head_dim),
-                          block0.dtype),
+
+        def one_cache():
+            if self._kv_quant:
+                return (
+                    jnp.zeros((slots, heads, self._cache_len, head_dim),
+                              jnp.int8),
+                    jnp.zeros((slots, heads, self._cache_len, 1),
+                              jnp.float32),
+                )
+            return jnp.zeros(
+                (slots, heads, self._cache_len, head_dim), block0.dtype
             )
-            for _ in lm.block_names
-        ]
+
+        self._caches = [(one_cache(), one_cache()) for _ in lm.block_names]
         self._queue: collections.deque[_Request] = collections.deque()
         self._done: dict[int, np.ndarray] = {}
         self._next_id = 0
@@ -162,7 +181,8 @@ class ContinuousBatcher:
                 self.lm.block_names, self._blocks, caches
             ):
                 x, ck, cv = block.apply(
-                    variables[name], x, ck, cv, pos, method="decode_step"
+                    variables[name], x, ck, cv, pos, None,
+                    self._kv_quant, method="decode_step",
                 )
                 new_caches.append((ck, cv))
             logits = self._head.apply(variables["head"], x)[:, 0]  # (B, V)
@@ -195,7 +215,8 @@ class ContinuousBatcher:
             kvs = []
             for name, block in zip(self.lm.block_names, self._blocks):
                 h, ck, cv = block.apply(
-                    variables[name], h, bucket, method="prefill"
+                    variables[name], h, bucket, None, self._kv_quant,
+                    method="prefill",
                 )
                 kvs.append((ck, cv))
             h_last = lax.dynamic_index_in_dim(h, true_len - 1, 1)
@@ -214,15 +235,19 @@ class ContinuousBatcher:
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
     def _insert(self, caches, slot, kvs):
-        """Write a prefilled request's K/V into slot row ``slot``."""
-        out = []
-        for (ck, cv), (nk, nv) in zip(caches, kvs):
-            ck = lax.dynamic_update_slice(ck, nk.astype(ck.dtype),
-                                          (slot, 0, 0, 0))
-            cv = lax.dynamic_update_slice(cv, nv.astype(cv.dtype),
-                                          (slot, 0, 0, 0))
-            out.append((ck, cv))
-        return out
+        """Write a prefilled request's K/V into slot row ``slot``
+        (tree.map reaches the (values, scales) leaves of int8 caches and
+        the plain arrays of native ones alike)."""
+        return [
+            jax.tree.map(
+                lambda c, n: lax.dynamic_update_slice(
+                    c, n.astype(c.dtype), (slot, 0, 0, 0)
+                ),
+                c_pair,
+                n_pair,
+            )
+            for c_pair, n_pair in zip(caches, kvs)
+        ]
 
     # -- request lifecycle -------------------------------------------------
 
@@ -259,13 +284,18 @@ class ContinuousBatcher:
         if rng is None:
             rng = jax.random.PRNGKey(0)
         # generate()'s exact schedule: split -> key0 + per-step keys, each
-        # folded with the row index (0 — solo semantics).
+        # folded with the row index (0 — solo semantics). One vmapped
+        # dispatch + one host fetch, not O(steps) of them — this runs on
+        # the serving control path.
         rng_next, key0 = jax.random.split(rng)
-        step_keys = [key0] + (
-            list(jax.random.split(rng_next, steps - 1)) if steps > 1 else []
-        )
-        folded = np.stack(
-            [np.asarray(jax.random.fold_in(k, 0)) for k in step_keys]
+        if steps > 1:
+            step_keys = jnp.concatenate(
+                [key0[None], jax.random.split(rng_next, steps - 1)]
+            )
+        else:
+            step_keys = key0[None]
+        folded = np.asarray(
+            jax.vmap(jax.random.fold_in, in_axes=(0, None))(step_keys, 0)
         )
         req = _Request(
             req_id=self._next_id,

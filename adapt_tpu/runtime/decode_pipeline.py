@@ -271,7 +271,11 @@ class PipelinedDecoder:
         after microbatch ``m`` commits token ``s`` (test/chaos hook).
         Ragged prompts and int8 caches are SPMD-path features
         (``parallel.pipeline_decode``); this path covers the sampling
-        knobs + EOS."""
+        knobs + EOS. Scope note: stages run on in-process device-owning
+        workers — the failure domain the chaos hooks model. For
+        multi-HOST scale, the SPMD path runs over any jax Mesh
+        (ICI/DCN); a cross-host MPMD decode session (server-side session
+        caches over ``comm.remote``) is deliberately not claimed here."""
         prompt = jnp.asarray(prompt)
         b, s0 = prompt.shape
         _, rng, do_sample = validate_generate_args(

@@ -16,7 +16,12 @@ beats the convoy).
 
 Artifact: results/r04/continuous_serve.json. Runs on the real chip by
 default; ``--cpu`` validates the schedule on the host backend (and is
-what CI-grade environments can run).
+what CI-grade environments can run). Honest caveat on the CPU number:
+with the tiny validation model a decode step is microseconds of real
+compute, so per-chunk dispatch overhead dominates and batch-synchronous
+fused scans still win (measured 0.83x at chunk=16; 0.42x at chunk=8) —
+the convoy-effect thesis is for serving-scale models where a step is
+real milliseconds, which only the TPU run can settle.
 
 Usage: ``python benchmarks/continuous_serve.py [--slots 8]
 [--requests 32] [--cpu]``

@@ -112,6 +112,24 @@ def test_more_requests_than_slots(lm_setup):
         )
 
 
+def test_int8_slot_caches_match_generate_int8(lm_setup):
+    """Quantized slot caches reproduce generate(kv_cache_dtype="int8")
+    exactly — same absmax-per-vector scheme, so the only difference is
+    where the cache lives."""
+    lm, variables = lm_setup
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(0, 37, size=n).astype(np.int32)
+               for n in (4, 7, 3)]
+    bat = ContinuousBatcher(
+        lm, variables, slots=2, kv_cache_dtype="int8", chunk=4
+    )
+    ids = {bat.submit(p, 6): p for p in prompts}
+    out = bat.run()
+    for rid, p in ids.items():
+        want = _solo(lm, variables, p, 6, kv_cache_dtype="int8")
+        np.testing.assert_array_equal(out[rid], want)
+
+
 def test_validation(lm_setup):
     lm, variables = lm_setup
     bat = ContinuousBatcher(lm, variables, slots=2)

@@ -593,4 +593,4 @@ def test_crash_eviction_is_event_driven_hang_is_not(devices):
         )
     finally:
         hang_w.stop()
-        registry.close() if hasattr(registry, "close") else None
+        crash_w.stop()
