@@ -85,11 +85,14 @@ class _StageProgram:
 
 
 def _build_stage_programs(
-    lm: TransformerLM, variables, boundaries: Sequence[int]
+    lm: TransformerLM, variables, boundaries: Sequence[int],
+    kv_quant: bool = False,
 ) -> list[_StageProgram]:
     """Cut the decoder into stages at block ``boundaries`` (stage i runs
     blocks [boundaries[i], boundaries[i+1])); stage 0 owns the embed,
-    the last stage owns the head."""
+    the last stage owns the head. ``kv_quant`` stores stage KV caches
+    int8 (absmax per vector, generate()'s scheme) — replay rebuilds
+    quantized caches identically, so recovery parity carries over."""
     g = lm.graph
     embed = g.node("embed").module
     head = g.node("head").module
@@ -125,7 +128,8 @@ def _build_stage_programs(
             caches = []
             for name, m in zip(_names, _mods):
                 h, ck, cv = m.apply(
-                    svars[name], h, lm.max_len, method="prefill"
+                    svars[name], h, lm.max_len, None, kv_quant,
+                    method="prefill",
                 )
                 caches.append((ck, cv))
             out = (
@@ -143,7 +147,8 @@ def _build_stage_programs(
             new_caches = []
             for name, m, (ck, cv) in zip(_names, _mods, caches):
                 x, ck, cv = m.apply(
-                    svars[name], x, ck, cv, index, method="decode_step"
+                    svars[name], x, ck, cv, index, None, kv_quant,
+                    method="decode_step",
                 )
                 new_caches.append((ck, cv))
             out = head.apply(svars["head"], x)[:, 0] if _last else x
@@ -198,10 +203,19 @@ class PipelinedDecoder:
         boundaries: Sequence[int],
         devices: Sequence[jax.Device] | None = None,
         fault: FaultConfig | None = None,
+        kv_cache_dtype: str = "native",
     ):
         self.lm = lm
         self.fault = fault or FaultConfig()
-        self.programs = _build_stage_programs(lm, variables, boundaries)
+        if kv_cache_dtype not in ("native", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype={kv_cache_dtype!r}: expected 'native' "
+                "or 'int8'"
+            )
+        self.kv_cache_dtype = kv_cache_dtype
+        self.programs = _build_stage_programs(
+            lm, variables, boundaries, kv_quant=kv_cache_dtype == "int8"
+        )
         devices = list(devices if devices is not None else jax.devices())
         if not devices:
             raise ValueError("no devices")
@@ -269,9 +283,11 @@ class PipelinedDecoder:
         """Token-for-token ``generate()`` semantics, served through the
         stage workers with mid-decode failover. ``on_token(m, s)`` fires
         after microbatch ``m`` commits token ``s`` (test/chaos hook).
-        Ragged prompts and int8 caches are SPMD-path features
+        Ragged prompts remain an SPMD-path feature
         (``parallel.pipeline_decode``); this path covers the sampling
-        knobs + EOS. Scope note: stages run on in-process device-owning
+        knobs, EOS, and int8 stage caches (constructor
+        ``kv_cache_dtype``). Scope note: stages run on in-process
+        device-owning
         workers — the failure domain the chaos hooks model. For
         multi-HOST scale, the SPMD path runs over any jax Mesh
         (ICI/DCN); a cross-host MPMD decode session (server-side session
@@ -279,7 +295,8 @@ class PipelinedDecoder:
         prompt = jnp.asarray(prompt)
         b, s0 = prompt.shape
         _, rng, do_sample = validate_generate_args(
-            self.lm, prompt, steps, temperature, top_k, rng, None, "native"
+            self.lm, prompt, steps, temperature, top_k, rng, None,
+            self.kv_cache_dtype,
         )
         n_stages = len(self.programs)
         # Default: as many microbatches as keep all stages busy, rounded

@@ -113,6 +113,30 @@ def test_kill_during_eos_sampling_session(devices, lm_setup):
     np.testing.assert_array_equal(got, want_eos)
 
 
+def test_int8_stage_caches_survive_kill(devices, lm_setup):
+    """int8 stage caches + a mid-decode crash: replay rebuilds the
+    quantized caches identically, so output still equals
+    generate(kv_cache_dtype="int8")."""
+    lm, variables, prompt = lm_setup
+    want = np.asarray(
+        generate(lm, variables, prompt, 7, kv_cache_dtype="int8")
+    )
+    killed = []
+    with PipelinedDecoder(
+        lm, variables, [2], devices=devices[:3], fault=FAST,
+        kv_cache_dtype="int8",
+    ) as dec:
+
+        def on_token(m, s):
+            if not killed and s == 3:
+                killed.append(1)
+                dec.kill_worker(0, mode="crash")
+
+        got = dec.generate(prompt, 7, on_token=on_token)
+    assert killed
+    np.testing.assert_array_equal(got, want)
+
+
 def test_rejects_bad_boundaries(devices, lm_setup):
     lm, variables, _ = lm_setup
     for bad in ([3, 1], [0], [4], [2, 2]):
@@ -129,3 +153,12 @@ def test_rejects_bad_microbatch_split(devices, lm_setup):
     ) as dec:
         with pytest.raises(ValueError, match="microbatch"):
             dec.generate(prompt, 4, num_microbatches=3)
+
+
+def test_rejects_bad_kv_dtype(devices, lm_setup):
+    lm, variables, _ = lm_setup
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        PipelinedDecoder(
+            lm, variables, [2], devices=devices[:2], fault=FAST,
+            kv_cache_dtype="int4",
+        )
