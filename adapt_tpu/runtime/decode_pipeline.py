@@ -50,6 +50,7 @@ from adapt_tpu.control.registry import WorkerRegistry
 from adapt_tpu.control.worker import StageWorker, Task, TaskResult
 from adapt_tpu.models.transformer_lm import (
     TransformerLM,
+    _left_align,
     sample_next_tokens,
     validate_generate_args,
 )
@@ -79,8 +80,8 @@ class _StageProgram:
     first: bool
     last: bool
     block_range: tuple[int, int]
-    prefill_fn: Callable  # (vars, payload) -> (out, caches)
-    decode_fn: Callable  # (vars, (x, caches, index)) -> (out, caches)
+    prefill_fn: Callable  # (vars, (x, pos_ids?, vf?)) -> (out, caches)
+    decode_fn: Callable  # (vars, (x, caches, index, vf?)) -> (out, caches)
     variables: Any  # host master copy (rebind source)
 
 
@@ -119,16 +120,27 @@ def _build_stage_programs(
             stage_vars["head"] = variables["head"]
         mods = blocks[lo:hi]
 
-        def prefill_fn(svars, ids_or_h, _mods=mods, _first=first, _last=last,
+        def prefill_fn(svars, payload, _mods=mods, _first=first, _last=last,
                        _names=names):
+            # payload = (ids-or-h, pos_ids-or-None, valid_from-or-None);
+            # None members change the payload pytree structure, so the
+            # dense and ragged variants jit-compile separately with no
+            # runtime branching.
+            x, pos_ids, vf = payload
             if _first:
-                h = embed.apply(svars["embed"], ids_or_h)
+                if pos_ids is not None:
+                    h = embed.apply(
+                        svars["embed"], x, pos_ids,
+                        method="embed_positions",
+                    )
+                else:
+                    h = embed.apply(svars["embed"], x)
             else:
-                h = ids_or_h
+                h = x
             caches = []
             for name, m in zip(_names, _mods):
                 h, ck, cv = m.apply(
-                    svars[name], h, lm.max_len, None, kv_quant,
+                    svars[name], h, lm.max_len, vf, kv_quant,
                     method="prefill",
                 )
                 caches.append((ck, cv))
@@ -139,15 +151,21 @@ def _build_stage_programs(
 
         def decode_fn(svars, payload, _mods=mods, _first=first, _last=last,
                       _names=names):
-            x, caches, index = payload
+            x, caches, index, vf = payload
             if _first:
-                x = embed.apply(
-                    svars["embed"], x[:, None], index, method="embed_at"
-                )
+                if vf is not None:
+                    x = embed.apply(
+                        svars["embed"], x[:, None], (index - vf)[:, None],
+                        method="embed_positions",
+                    )
+                else:
+                    x = embed.apply(
+                        svars["embed"], x[:, None], index, method="embed_at"
+                    )
             new_caches = []
             for name, m, (ck, cv) in zip(_names, _mods, caches):
                 x, ck, cv = m.apply(
-                    svars[name], x, ck, cv, index, None, kv_quant,
+                    svars[name], x, ck, cv, index, vf, kv_quant,
                     method="decode_step",
                 )
                 new_caches.append((ck, cv))
@@ -177,7 +195,7 @@ _PREFILL_KEY = 1000
 class _MicrobatchState:
     """Where one microbatch is in its token loop."""
 
-    prompt: Any  # this microbatch's prompt slice (replay anchor)
+    prompt: Any  # this microbatch's (aligned) prompt slice (replay anchor)
     tokens: list  # committed sampled tokens, np arrays (mb,)
     done_rows: np.ndarray  # EOS latch per row
     caches: list  # per-stage cache pytrees (device-resident)
@@ -185,6 +203,8 @@ class _MicrobatchState:
     stage: int = 0  # stage currently (or next) running
     passno: int = 0  # decode pass number (consumes token `passno`)
     carry: Any = None  # activation flowing between stages
+    pos_ids: Any = None  # ragged: per-row logical positions (mb, s0)
+    vf: Any = None  # ragged: per-row left-pad counts (mb,)
 
 
 class PipelinedDecoder:
@@ -277,27 +297,31 @@ class PipelinedDecoder:
         top_k: int | None = None,
         eos_id: int | None = None,
         rng: jax.Array | None = None,
+        prompt_lengths: jax.Array | None = None,
         num_microbatches: int | None = None,
         on_token: Callable[[int, int], None] | None = None,
     ) -> np.ndarray:
         """Token-for-token ``generate()`` semantics, served through the
         stage workers with mid-decode failover. ``on_token(m, s)`` fires
         after microbatch ``m`` commits token ``s`` (test/chaos hook).
-        Ragged prompts remain an SPMD-path feature
-        (``parallel.pipeline_decode``); this path covers the sampling
-        knobs, EOS, and int8 stage caches (constructor
+        Covers the sampling knobs, EOS, ragged prompts
+        (``prompt_lengths``), and int8 stage caches (constructor
         ``kv_cache_dtype``). Scope note: stages run on in-process
-        device-owning
-        workers — the failure domain the chaos hooks model. For
-        multi-HOST scale, the SPMD path runs over any jax Mesh
-        (ICI/DCN); a cross-host MPMD decode session (server-side session
-        caches over ``comm.remote``) is deliberately not claimed here."""
+        device-owning workers — the failure domain the chaos hooks
+        model. For multi-HOST scale, the SPMD path
+        (``parallel.pipeline_decode``) runs over any jax Mesh (ICI/DCN);
+        a cross-host MPMD decode session (server-side session caches
+        over ``comm.remote``) is deliberately not claimed here."""
         prompt = jnp.asarray(prompt)
         b, s0 = prompt.shape
-        _, rng, do_sample = validate_generate_args(
-            self.lm, prompt, steps, temperature, top_k, rng, None,
-            self.kv_cache_dtype,
+        lengths, rng, do_sample = validate_generate_args(
+            self.lm, prompt, steps, temperature, top_k, rng,
+            prompt_lengths, self.kv_cache_dtype,
         )
+        if prompt_lengths is not None:
+            prompt, pos_ids, valid_from = _left_align(prompt, lengths)
+        else:
+            pos_ids = valid_from = None
         n_stages = len(self.programs)
         # Default: as many microbatches as keep all stages busy, rounded
         # down to a divisor of the batch.
@@ -320,6 +344,16 @@ class PipelinedDecoder:
                 done_rows=np.zeros((mb,), bool),
                 caches=[None] * n_stages,
                 carry=prompt[m * mb:(m + 1) * mb],
+                pos_ids=(
+                    pos_ids[m * mb:(m + 1) * mb]
+                    if pos_ids is not None
+                    else None
+                ),
+                vf=(
+                    valid_from[m * mb:(m + 1) * mb]
+                    if valid_from is not None
+                    else None
+                ),
             )
             for m in range(M)
         ]
@@ -350,13 +384,19 @@ class PipelinedDecoder:
             prog = self.programs[st.stage]
             rid = next(self._rid)
             if st.phase == "prefill":
-                key, payload = st.stage + _PREFILL_KEY, st.carry
+                key = st.stage + _PREFILL_KEY
+                payload = (
+                    st.carry,
+                    st.pos_ids if st.stage == 0 else None,
+                    st.vf,
+                )
             else:
                 key = st.stage
                 payload = (
                     st.carry,
                     st.caches[st.stage],
                     jnp.asarray(s0 + st.passno, jnp.int32),
+                    st.vf,
                 )
             # Stage workers drain their inboxes serially, so queue wait
             # counts toward the deadline — scale it by the tasks already
@@ -549,7 +589,11 @@ class PipelinedDecoder:
             # every stage...
             x = st.prompt
             for k in range(len(self.programs)):
-                x, caches = run(k, k + _PREFILL_KEY, x)
+                x, caches = run(
+                    k,
+                    k + _PREFILL_KEY,
+                    (x, st.pos_ids if k == 0 else None, st.vf),
+                )
                 st.caches[k] = caches
             # ...then forced passes replay committed tokens 0..n-2 (the
             # last committed token is consumed by the pass the event loop
@@ -560,7 +604,12 @@ class PipelinedDecoder:
                     x, caches = run(
                         k,
                         k,
-                        (x, st.caches[k], jnp.asarray(s0 + p, jnp.int32)),
+                        (
+                            x,
+                            st.caches[k],
+                            jnp.asarray(s0 + p, jnp.int32),
+                            st.vf,
+                        ),
                     )
                     st.caches[k] = caches
         log.warning(

@@ -1,0 +1,44 @@
+#!/bin/bash
+# Round-4 TPU measurement queue — run the moment the relay recovers.
+# Serial by design: NEVER two JAX processes through the relay at once.
+# Each driver already guards itself (subprocess + hard timeout + one
+# JSON line), so a relay re-outage mid-queue degrades to error rows,
+# not hangs. Usage: bash benchmarks/r04_tpu_queue.sh
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/results/r04
+mkdir -p "$OUT"
+log() { echo "=== $(date +%H:%M:%S) $*"; }
+
+log "0. probe"
+timeout 90 python -c "import jax; print(jax.devices())" || {
+  echo "relay still down; aborting queue"; exit 1; }
+
+log "1. headline bench.py (ResNet-50 bs=32)"
+timeout 2400 python bench.py | tail -1 | tee "$OUT/bench_preview.json"
+
+log "2. lm_decode default (bs8 steps128 prompt64 maxlen256)"
+timeout 1800 python benchmarks/lm_decode.py | tail -1 \
+  | tee "$OUT/lm_decode.json"
+
+log "3. int8 KV A/B at long context (cache traffic rivals weights)"
+timeout 1800 python benchmarks/lm_decode.py --prompt 1024 --maxlen 2048 \
+  --steps 128 | tail -1 | tee "$OUT/lm_decode_long_native.json"
+timeout 1800 python benchmarks/lm_decode.py --prompt 1024 --maxlen 2048 \
+  --steps 128 --kv int8 | tail -1 | tee "$OUT/lm_decode_long_int8.json"
+
+log "4. ViT-B/16 MFU push: batch x residency sweep"
+for BS in 32 64 128; do
+  timeout 1500 python benchmarks/tpu_models.py --model vit_b16 \
+    --batch "$BS" | tail -1 | tee "$OUT/vit_b16_bs${BS}.json"
+  timeout 1500 python benchmarks/tpu_models.py --model vit_b16 \
+    --batch "$BS" --resident bf16 | tail -1 \
+    | tee "$OUT/vit_b16_bs${BS}_res_bf16.json"
+done
+
+log "5. continuous batching at serving scale (GPT-2 width)"
+timeout 2400 python benchmarks/continuous_serve.py --slots 8 \
+  --requests 32 --chunk 16 | tail -1
+# (driver writes results/r04/continuous_serve.json itself)
+
+log "queue done"

@@ -146,6 +146,68 @@ def test_rejects_bad_boundaries(devices, lm_setup):
             )
 
 
+def test_ragged_prompts_survive_kill(devices, lm_setup):
+    """Ragged batches (right-padded + prompt_lengths) through the decode
+    session, with a crash mid-decode: the replay must rebuild the
+    left-aligned masked caches and still match generate() row for row."""
+    lm, variables, _ = lm_setup
+    lens = [2, 5, 3, 6]
+    s0 = max(lens)
+    prompt = jax.random.randint(jax.random.PRNGKey(9), (4, s0), 0, 59)
+    lengths = jnp.asarray(lens)
+    want = np.asarray(
+        generate(lm, variables, prompt, 6, prompt_lengths=lengths)
+    )
+    killed = []
+    with PipelinedDecoder(
+        lm, variables, [2], devices=devices[:3], fault=FAST
+    ) as dec:
+
+        def on_token(m, s):
+            if not killed and s == 2:
+                killed.append(1)
+                dec.kill_worker(1, mode="crash")
+
+        got = dec.generate(
+            prompt, 6, prompt_lengths=lengths, on_token=on_token
+        )
+    assert killed
+    np.testing.assert_array_equal(got, want)
+
+
+def test_ragged_int8_compose_under_kill(devices, lm_setup):
+    """Ragged prompts AND int8 stage caches together (they compose: the
+    vf mask must keep quantized left-pad slots out of every window, and
+    replay must rebuild the quantized masked caches), plus a crash."""
+    lm, variables, _ = lm_setup
+    lens = [3, 6, 2, 4]
+    s0 = max(lens)
+    prompt = jax.random.randint(jax.random.PRNGKey(11), (4, s0), 0, 59)
+    lengths = jnp.asarray(lens)
+    want = np.asarray(
+        generate(
+            lm, variables, prompt, 5, prompt_lengths=lengths,
+            kv_cache_dtype="int8",
+        )
+    )
+    killed = []
+    with PipelinedDecoder(
+        lm, variables, [2], devices=devices[:3], fault=FAST,
+        kv_cache_dtype="int8",
+    ) as dec:
+
+        def on_token(m, s):
+            if not killed and s == 2:
+                killed.append(1)
+                dec.kill_worker(0, mode="crash")
+
+        got = dec.generate(
+            prompt, 5, prompt_lengths=lengths, on_token=on_token
+        )
+    assert killed
+    np.testing.assert_array_equal(got, want)
+
+
 def test_rejects_bad_microbatch_split(devices, lm_setup):
     lm, variables, prompt = lm_setup
     with PipelinedDecoder(
