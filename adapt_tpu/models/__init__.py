@@ -9,6 +9,7 @@ Keras layer naming the reference cuts on (e.g. ``conv3_block1_out``,
 
 from adapt_tpu.models.efficientnet import efficientnet_b0, efficientnet_b4
 from adapt_tpu.models.resnet import resnet50, resnet101, resnet152
+from adapt_tpu.models.speculative import speculative_generate
 from adapt_tpu.models.transformer_lm import generate, lm_tiny, transformer_lm
 from adapt_tpu.models.vit import vit_b16, vit_tiny
 
@@ -37,4 +38,5 @@ __all__ = [
     "transformer_lm",
     "lm_tiny",
     "generate",
+    "speculative_generate",
 ]

@@ -1,0 +1,228 @@
+"""Speculative decoding: draft cheap, verify in one cached pass.
+
+Autoregressive decode is bandwidth-bound — every emitted token streams
+all weights once (``benchmarks/lm_decode.py``'s MBU framing). Speculative
+decoding buys tokens-per-weight-stream: a cheap DRAFT model proposes
+``draft_k`` tokens, the big model scores all of them in ONE cached
+forward (``verify_chunk`` — K causal logits against the KV cache for one
+weight stream instead of K), and the longest agreeing prefix is accepted
+plus one correction token from the big model's own logits. Greedy
+speculative decoding is LOSSLESS: the emitted stream is exactly the big
+model's greedy stream whatever the draft proposes (the draft only
+changes HOW FAST it is produced) — which is the tested contract here:
+token-for-token equality with ``generate()``, from a perfect draft
+(acceptance 1.0) down to an adversarially wrong one (acceptance 0, one
+token per round, still correct).
+
+TPU shape discipline: the per-round programs are two fixed-shape jits —
+a ``draft_k + 1``-step draft scan and a ``draft_k + 1``-token verify
+chunk — so rounds never recompile regardless of acceptance. Rejected
+speculation needs NO rollback on either cache: cache entries past the
+accepted position are simply never admitted by the position masks and
+get overwritten by later rounds (the same discipline the continuous
+batcher's trash slot and the SPMD ring's bubble ticks use). Caches are
+allocated with ``draft_k + 1`` slack positions so overshoot writes land
+in masked space.
+
+v1 scope: greedy (temperature 0 — where losslessness is exact equality),
+batch size 1 (per-row acceptance desynchronizes rows; batch speculation
+composes with the continuous batcher later), native-dtype caches. No
+reference analog (CNN-only); this is the serving-latency frontier for
+the repo's flagship LM workload.
+
+Numerics fine print: "exact equality" assumes the chunked verify and the
+sequential decode produce bitwise-equal logits. They run the same ops in
+the same dtypes, but XLA may reorder reductions between the (K, L) and
+(1, L) shapes; under bf16 a near-tie argmax could then flip a token. The
+f32 test suite pins exactness; the hardware benchmark reports a
+mismatch count rather than assuming it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from adapt_tpu.models.transformer_lm import TransformerLM
+
+
+def _modules(lm: TransformerLM):
+    g = lm.graph
+    return (
+        g.node("embed").module,
+        [g.node(n).module for n in lm.block_names],
+        g.node("head").module,
+    )
+
+
+@partial(jax.jit, static_argnames=("lm", "cache_len"))
+def _prefill(lm: TransformerLM, variables, prompt, *, cache_len: int):
+    """Full prompt forward building caches padded to ``cache_len``;
+    returns (greedy first token (b,), caches)."""
+    embed, blocks, head = _modules(lm)
+    h = embed.apply(variables["embed"], prompt)
+    caches = []
+    for name, block in zip(lm.block_names, blocks):
+        h, ck, cv = block.apply(
+            variables[name], h, cache_len, method="prefill"
+        )
+        caches.append((ck, cv))
+    logits = head.apply(variables["head"], h[:, -1:, :])[:, 0]
+    return jnp.argmax(logits, axis=-1).astype(prompt.dtype), caches
+
+
+@partial(jax.jit, static_argnames=("lm", "n"))
+def _draft_chunk(lm: TransformerLM, variables, tok, index, caches, *, n):
+    """``n`` greedy decode steps of the draft model: consumes ``tok`` at
+    ``index``, returns its next-token chain (n, b) and updated caches."""
+    embed, blocks, head = _modules(lm)
+
+    def step(carry, _):
+        tok, index, caches = carry
+        x = embed.apply(
+            variables["embed"], tok[:, None], index, method="embed_at"
+        )
+        new_caches = []
+        for name, block, (ck, cv) in zip(lm.block_names, blocks, caches):
+            x, ck, cv = block.apply(
+                variables[name], x, ck, cv, index, method="decode_step"
+            )
+            new_caches.append((ck, cv))
+        logits = head.apply(variables["head"], x)[:, 0]
+        nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+        return (nxt, index + 1, tuple(new_caches)), nxt
+
+    (_, _, caches), toks = lax.scan(
+        step, (tok, index, tuple(caches)), None, length=n
+    )
+    return toks, list(caches)
+
+
+@partial(jax.jit, static_argnames=("lm",))
+def _verify_chunk(lm: TransformerLM, variables, tokens, index, caches):
+    """One cached forward over a (b, K) token chunk starting at
+    ``index``; returns the big model's greedy prediction AFTER each
+    chunk position ((b, K)) and updated caches."""
+    embed, blocks, head = _modules(lm)
+    kc = tokens.shape[1]
+    pos = index + jnp.arange(kc)[None, :]
+    x = embed.apply(
+        variables["embed"], tokens, pos, method="embed_positions"
+    )
+    new_caches = []
+    for name, block, (ck, cv) in zip(lm.block_names, blocks, caches):
+        x, ck, cv = block.apply(
+            variables[name], x, ck, cv, index, method="verify_chunk"
+        )
+        new_caches.append((ck, cv))
+    logits = head.apply(variables["head"], x)  # (b, K, V)
+    return jnp.argmax(logits, axis=-1).astype(tokens.dtype), new_caches
+
+
+def speculative_generate(
+    lm: TransformerLM,
+    variables,
+    prompt: jax.Array,
+    steps: int,
+    draft_lm: TransformerLM,
+    draft_variables,
+    draft_k: int = 4,
+    eos_id: int | None = None,
+    return_stats: bool = False,
+):
+    """Greedy generation accelerated by a draft model; output is
+    token-for-token identical to ``generate(lm, variables, prompt,
+    steps)`` (and EOS-padded identically when ``eos_id`` is set).
+
+    prompt: (1, s0) int32 ids. ``draft_lm``/``draft_variables`` must
+    share the vocab; its quality only affects speed (the per-round
+    acceptance), never the output. With ``return_stats`` the emitted
+    array comes with {"rounds", "drafted", "accepted", "acceptance"}.
+    """
+    prompt = jnp.asarray(prompt)
+    b, s0 = prompt.shape
+    if b != 1:
+        raise ValueError(
+            f"speculative_generate is single-request (b=1), got b={b}; "
+            "batch speculation desynchronizes rows per-round"
+        )
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if draft_k < 1:
+        raise ValueError(f"draft_k must be >= 1, got {draft_k}")
+    if s0 + steps > lm.max_len:
+        raise ValueError(
+            f"prompt {s0} + steps {steps} exceeds max_len {lm.max_len}"
+        )
+    if s0 + steps > draft_lm.max_len:
+        raise ValueError(
+            f"prompt {s0} + steps {steps} exceeds draft max_len "
+            f"{draft_lm.max_len}"
+        )
+    if draft_lm.vocab != lm.vocab:
+        raise ValueError(
+            f"draft vocab {draft_lm.vocab} != target vocab {lm.vocab}"
+        )
+    # draft_k + 1 slack: a round's chunk writes up to index + draft_k
+    # positions, of which only the accepted prefix ever becomes live.
+    cache_len = lm.max_len + draft_k + 1
+    draft_cache_len = draft_lm.max_len + draft_k + 1
+    d = draft_k
+
+    first, caches = _prefill(lm, variables, prompt, cache_len=cache_len)
+    _, dcaches = _prefill(
+        draft_lm, draft_variables, prompt, cache_len=draft_cache_len
+    )
+
+    emitted = [int(first[0])]
+    index = s0  # both models: position where the NEXT consumed token lands
+    rounds = drafted = accepted = 0
+    while len(emitted) < steps:
+        t0 = jnp.asarray([emitted[-1]], prompt.dtype)
+        # Draft d proposals (plus one throwaway step so the draft's own
+        # cache covers every token the next round may start after).
+        dtoks, dcaches = _draft_chunk(
+            draft_lm, draft_variables, t0, jnp.asarray(index, jnp.int32),
+            dcaches, n=d + 1,
+        )
+        props = np.asarray(dtoks)[:d, 0]  # d proposals
+        chunk = jnp.concatenate(
+            [t0[:, None], jnp.asarray(props, prompt.dtype)[None, :]], axis=1
+        )  # (1, d+1): [t0, d1..dd]
+        preds, caches = _verify_chunk(
+            lm, variables, chunk, jnp.asarray(index, jnp.int32), caches
+        )
+        preds = np.asarray(preds)[0]  # preds[i] = greedy after chunk[i]
+        # Longest agreeing prefix: preds[i-1] == d_i.
+        a = 0
+        while a < d and preds[a] == props[a]:
+            a += 1
+        new = [int(t) for t in props[:a]] + [int(preds[a])]
+        rounds += 1
+        drafted += d
+        accepted += a
+        emitted.extend(new)
+        index += a + 1
+        if eos_id is not None and eos_id in new:
+            break  # finished; the tail below pads with EOS
+    emitted = emitted[:steps]
+    while len(emitted) < steps:
+        emitted.append(eos_id)
+    out = np.asarray(emitted, np.int32)[None, :]
+    if eos_id is not None:
+        # generate()'s convention: a finished row pads with EOS forever.
+        hits = np.nonzero(out[0] == eos_id)[0]
+        if hits.size:
+            out[0, hits[0]:] = eos_id
+    if return_stats:
+        return out, {
+            "rounds": rounds,
+            "drafted": drafted,
+            "accepted": accepted,
+            "acceptance": accepted / drafted if drafted else 0.0,
+        }
+    return out

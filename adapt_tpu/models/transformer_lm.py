@@ -201,6 +201,41 @@ class CausalSelfAttention(nn.Module):
         return self.out(o), cache_k, cache_v
 
 
+    def verify_chunk(self, x, cache_k, cache_v, index):
+        """Append a CHUNK of ``K`` tokens at positions
+        ``index..index+K-1`` in ONE cached pass — the speculative-decode
+        verify primitive: each chunk row's query attends the cache up to
+        its own position (``p <= index + row``), so the K logits equal
+        exactly what K sequential ``decode_step`` calls would produce,
+        for one forward instead of K. The chunk K/V write is a single
+        contiguous ``dynamic_update_slice``; rejected suffixes need no
+        rollback — the position mask simply never admits them (the same
+        trash-slot discipline the continuous batcher uses)."""
+        b, kc, d = x.shape
+        q, k, v = self._project(x)  # each (b, h, K, hd)
+        sm = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+        cache_k = lax.dynamic_update_slice(cache_k, k, (0, 0, index, 0))
+        cache_v = lax.dynamic_update_slice(cache_v, v, (0, 0, index, 0))
+        s = (
+            jnp.einsum(
+                "bhqd,bhkd->bhqk",
+                q.astype(jnp.float32),
+                cache_k.astype(jnp.float32),
+            )
+            * sm
+        )  # (b, h, K, cache_len)
+        positions = jnp.arange(cache_k.shape[2])
+        rows = jnp.arange(kc)
+        live = positions[None, :] <= (index + rows)[:, None]  # (K, L)
+        s = jnp.where(live[None, None], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum(
+            "bhqk,bhkd->bhqd", p, cache_v.astype(jnp.float32)
+        ).astype(x.dtype)
+        o = jnp.swapaxes(o, 1, 2).reshape(b, kc, self.dim)
+        return self.out(o), cache_k, cache_v
+
+
 class DecoderBlock(nn.Module):
     """Pre-LN decoder block; residuals stay inside the node so block
     boundaries are clean pipeline cuts (same contract as ViT's
@@ -242,6 +277,13 @@ class DecoderBlock(nn.Module):
         )
         x_t = x_t + a
         return x_t + self._mlp(self.ln2(x_t)), ck, cv
+
+    def verify_chunk(self, x, cache_k, cache_v, index):
+        a, ck, cv = self.attn.verify_chunk(
+            self.ln1(x), cache_k, cache_v, index
+        )
+        x = x + a
+        return x + self._mlp(self.ln2(x)), ck, cv
 
 
 class TokenEmbed(nn.Module):

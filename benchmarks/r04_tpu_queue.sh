@@ -15,7 +15,9 @@ timeout 90 python -c "import jax; print(jax.devices())" || {
   echo "relay still down; aborting queue"; exit 1; }
 
 log "1. headline bench.py (ResNet-50 bs=32)"
-timeout 2400 python bench.py | tail -1 | tee "$OUT/bench_preview.json"
+# Outer timeout strictly ABOVE the driver's own worst case (3 TPU
+# attempts + backoffs), so its error-row handler always gets to run.
+timeout 3600 python bench.py | tail -1 | tee "$OUT/bench_preview.json"
 
 log "2. lm_decode default (bs8 steps128 prompt64 maxlen256)"
 timeout 1800 python benchmarks/lm_decode.py | tail -1 \
@@ -37,8 +39,15 @@ for BS in 32 64 128; do
 done
 
 log "5. continuous batching at serving scale (GPT-2 width)"
-timeout 2400 python benchmarks/continuous_serve.py --slots 8 \
+timeout 2700 python benchmarks/continuous_serve.py --slots 8 \
   --requests 32 --chunk 16 | tail -1
 # (driver writes results/r04/continuous_serve.json itself)
+
+log "6. speculative decoding mechanism bounds (GPT-2 width)"
+timeout 2700 python benchmarks/speculative_decode.py --draft self --k 4 \
+  | tail -1
+timeout 2700 python benchmarks/speculative_decode.py --draft tiny --k 4 \
+  | tail -1
+# (driver appends to results/r04/speculative_decode.json)
 
 log "queue done"

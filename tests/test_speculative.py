@@ -1,0 +1,103 @@
+"""Speculative decoding is LOSSLESS for greedy: whatever the draft
+model proposes, the emitted stream must equal the big model's own
+greedy generate() output — the draft may only change speed."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adapt_tpu.models.speculative import speculative_generate
+from adapt_tpu.models.transformer_lm import (
+    generate,
+    lm_tiny,
+    transformer_lm,
+)
+
+
+@pytest.fixture(scope="module")
+def big_setup():
+    lm = lm_tiny(vocab=41, max_len=48)
+    prompt = jax.random.randint(jax.random.PRNGKey(0), (1, 6), 0, 41)
+    variables = lm.graph.init(jax.random.PRNGKey(1), prompt)
+    return lm, variables, prompt
+
+
+@pytest.fixture(scope="module")
+def draft_setup():
+    # Same vocab, different (smaller) architecture, independent init —
+    # a real draft whose proposals are frequently wrong.
+    draft = transformer_lm(41, 32, 2, 2, 64, max_len=48, name="draft")
+    variables = draft.graph.init(
+        jax.random.PRNGKey(7), jnp.zeros((1, 4), jnp.int32)
+    )
+    return draft, variables
+
+
+def test_perfect_draft_full_acceptance(big_setup):
+    """Draft == target: every proposal accepted, output identical."""
+    lm, variables, prompt = big_setup
+    want = np.asarray(generate(lm, variables, prompt, 12))
+    got, stats = speculative_generate(
+        lm, variables, prompt, 12, lm, variables, draft_k=4,
+        return_stats=True,
+    )
+    np.testing.assert_array_equal(got, want)
+    assert stats["acceptance"] == 1.0
+    # d+1 = 5 tokens per round after the prefill token -> 3 rounds for
+    # the remaining 11.
+    assert stats["rounds"] == 3
+
+
+@pytest.mark.parametrize("draft_k", [1, 2, 4])
+def test_wrong_draft_still_lossless(big_setup, draft_setup, draft_k):
+    """An independent draft (mostly-rejected proposals) must not change
+    a single token — only the round count."""
+    lm, variables, prompt = big_setup
+    draft, dvars = draft_setup
+    want = np.asarray(generate(lm, variables, prompt, 10))
+    got, stats = speculative_generate(
+        lm, variables, prompt, 10, draft, dvars, draft_k=draft_k,
+        return_stats=True,
+    )
+    np.testing.assert_array_equal(got, want)
+    assert stats["rounds"] >= 1
+    assert 0.0 <= stats["acceptance"] <= 1.0
+
+
+@pytest.mark.parametrize("steps", [1, 2, 5])
+def test_step_edges(big_setup, draft_setup, steps):
+    lm, variables, prompt = big_setup
+    draft, dvars = draft_setup
+    want = np.asarray(generate(lm, variables, prompt, steps))
+    got = speculative_generate(
+        lm, variables, prompt, steps, draft, dvars, draft_k=3
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_eos_padding_matches_generate(big_setup, draft_setup):
+    lm, variables, prompt = big_setup
+    draft, dvars = draft_setup
+    greedy = np.asarray(generate(lm, variables, prompt, 10))
+    eos = int(greedy[0, 1])
+    want = np.asarray(generate(lm, variables, prompt, 10, eos_id=eos))
+    got = speculative_generate(
+        lm, variables, prompt, 10, draft, dvars, draft_k=3, eos_id=eos
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_validation(big_setup, draft_setup):
+    lm, variables, prompt = big_setup
+    draft, dvars = draft_setup
+    with pytest.raises(ValueError, match="b=1"):
+        speculative_generate(
+            lm, variables, jnp.zeros((2, 4), jnp.int32), 4, draft, dvars
+        )
+    other = lm_tiny(vocab=17, max_len=48)
+    ovars = other.graph.init(jax.random.PRNGKey(3), jnp.zeros((1, 4), jnp.int32))
+    with pytest.raises(ValueError, match="vocab"):
+        speculative_generate(lm, variables, prompt, 4, other, ovars)
+    with pytest.raises(ValueError, match="draft_k"):
+        speculative_generate(lm, variables, prompt, 4, draft, dvars, draft_k=0)
