@@ -41,9 +41,12 @@ serving, TPU-first:
 vector, the same scheme as ``generate``): ~2x the resident context per
 slot and ~2x less per-step cache traffic vs bf16 caches.
 
-Not in scope (v1): per-request top_k (it is a static shape — one value
-per batcher) and cross-chip slots (compose with the pipelined decoders
-for models bigger than one chip).
+``top_k`` is per-REQUEST despite being shape-like (see
+``_truncate_rows``); ticks with no truncating request skip the filter
+entirely via a static flag.
+
+Not in scope (v1): cross-chip slots (compose with the pipelined
+decoders for models bigger than one chip).
 """
 
 from __future__ import annotations
@@ -71,6 +74,7 @@ class _Request:
     prompt: np.ndarray  # (s0,) int32
     steps: int
     temperature: float
+    top_k: int  # == vocab -> no truncation
     eos_id: int | None
     folded_keys: np.ndarray  # (steps, 2) uint32 — pre-folded per-step keys
 
@@ -90,9 +94,10 @@ class _Slot:
 class ContinuousBatcher:
     """Slot-based continuous batching over one LM on one device.
 
-    ``slots`` is the lockstep decode width (static); ``top_k`` applies to
-    every sampled request (a static shape). Drive it with
-    :meth:`submit` + :meth:`run` (or :meth:`tick` for manual control).
+    ``slots`` is the lockstep decode width (static); ``top_k`` here is
+    only the DEFAULT for requests that do not pass their own (per-row
+    truncation: ``_truncate_rows``). Drive it with :meth:`submit` +
+    :meth:`run` (or :meth:`tick` for manual control).
     """
 
     def __init__(
@@ -159,16 +164,38 @@ class ContinuousBatcher:
 
     # -- compiled pieces ---------------------------------------------------
 
-    @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+    def _truncate_rows(self, lg, top_ks):
+        """Per-row top-k filter with a TRACED k: keep logits >= the k-th
+        largest (``sorted[V-k]`` — bitwise the same threshold
+        generate()'s ``lax.top_k`` filter uses, so mixed-top_k batches
+        match per-request ``generate`` without recompiling); k == V
+        keeps everything. Costs a full (B, V) sort, so callers gate it
+        behind a STATIC flag and skip it when no active request
+        truncates — the hot path must not pay O(V log V) for a no-op
+        (``sample_next_tokens``'s lax.top_k rule)."""
+        v = lg.shape[-1]
+        sorted_lg = jnp.sort(lg, axis=-1)  # ascending
+        idx = jnp.clip(v - top_ks, 0, v - 1)
+        kth = jnp.take_along_axis(sorted_lg, idx[:, None], axis=-1)
+        return jnp.where(lg >= kth, lg, -jnp.inf)
+
+    @partial(
+        jax.jit,
+        static_argnums=(0,),
+        static_argnames=("truncate",),
+        donate_argnums=(2,),
+    )
     def _step_chunk(self, variables, caches, tokens, pos, keys, temps,
-                    greedy):
+                    top_ks, greedy, *, truncate):
         """``chunk`` lockstep decode steps as one compiled scan.
 
         tokens/pos: (B,) int32 — per-slot input token and cache position
         (inactive slots: trash). keys (chunk, B, 2) — each step's
-        per-slot sampling keys. temps (B,) / greedy (B,) select per-row
-        sampling. Returns ((chunk, B) emitted tokens, caches); ONE
-        host sync per call, not per token."""
+        per-slot sampling keys. temps (B,) / top_ks (B,) / greedy (B,)
+        select per-row sampling; static ``truncate`` elides the top-k
+        sort when no active request truncates (two compiled variants at
+        most). Returns ((chunk, B) emitted tokens, caches); ONE host
+        sync per call, not per token."""
 
         def body(carry, step_keys):
             tokens, pos, caches = carry
@@ -188,9 +215,8 @@ class ContinuousBatcher:
             logits = self._head.apply(variables["head"], x)[:, 0]  # (B, V)
             pick_greedy = jnp.argmax(logits, axis=-1)
             lg = logits / jnp.maximum(temps, 1e-6)[:, None]
-            if self.top_k is not None:
-                kth = lax.top_k(lg, self.top_k)[0][:, -1:]
-                lg = jnp.where(lg >= kth, lg, -jnp.inf)
+            if truncate:
+                lg = self._truncate_rows(lg, top_ks)
             pick_sampled = jax.vmap(jax.random.categorical)(step_keys, lg)
             nxt = jnp.where(greedy, pick_greedy, pick_sampled).astype(
                 tokens.dtype
@@ -209,8 +235,9 @@ class ContinuousBatcher:
         if bucket in self._prefill_cache:
             return self._prefill_cache[bucket]
 
-        @jax.jit
-        def prefill(variables, ids, true_len, keys, temp, greedy):
+        @partial(jax.jit, static_argnames=("truncate",))
+        def prefill(variables, ids, true_len, keys, temp, top_k, greedy,
+                    *, truncate):
             h = self._embed.apply(variables["embed"], ids)
             kvs = []
             for name, block in zip(self.lm.block_names, self._blocks):
@@ -223,9 +250,8 @@ class ContinuousBatcher:
             logits = self._head.apply(variables["head"], h_last)[:, 0]
             pick_greedy = jnp.argmax(logits, axis=-1)
             lg = logits / jnp.maximum(temp, 1e-6)
-            if self.top_k is not None:
-                kth = lax.top_k(lg, self.top_k)[0][:, -1:]
-                lg = jnp.where(lg >= kth, lg, -jnp.inf)
+            if truncate:
+                lg = self._truncate_rows(lg, top_k[None])
             sampled = jax.vmap(jax.random.categorical)(keys, lg)
             first = jnp.where(greedy, pick_greedy, sampled)
             return first, kvs
@@ -256,12 +282,14 @@ class ContinuousBatcher:
         prompt,
         steps: int,
         temperature: float = 0.0,
+        top_k: int | None = None,
         eos_id: int | None = None,
         rng: jax.Array | None = None,
     ) -> int:
         """Queue one request; returns its id. ``prompt`` is a 1-D token
-        id sequence. The sampling-key schedule matches ``generate`` for
-        a solo batch, so outputs are reproducible against it."""
+        id sequence; ``top_k`` overrides the batcher default for this
+        request. The sampling-key schedule matches ``generate`` for a
+        solo batch, so outputs are reproducible against it."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         s0 = prompt.shape[0]
         if s0 < 1:
@@ -283,6 +311,11 @@ class ContinuousBatcher:
             raise ValueError("temperature > 0 requires an rng key")
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        top_k_eff = top_k if top_k is not None else self.top_k
+        if top_k_eff is not None and not (1 <= top_k_eff <= self.lm.vocab):
+            raise ValueError(
+                f"top_k {top_k_eff} outside [1, {self.lm.vocab}]"
+            )
         # generate()'s exact schedule: split -> key0 + per-step keys, each
         # folded with the row index (0 — solo semantics). One vmapped
         # dispatch + one host fetch, not O(steps) of them — this runs on
@@ -302,6 +335,7 @@ class ContinuousBatcher:
             prompt=prompt,
             steps=steps,
             temperature=float(temperature) if do_sample else 0.0,
+            top_k=top_k_eff if top_k_eff is not None else self.lm.vocab,
             eos_id=eos_id,
             folded_keys=folded,
         )
@@ -346,7 +380,9 @@ class ContinuousBatcher:
                 jnp.asarray(s0, jnp.int32),
                 jnp.asarray(req.folded_keys[0][None]),
                 jnp.asarray(req.temperature, jnp.float32),
+                jnp.asarray(req.top_k, jnp.int32),
                 jnp.asarray(req.temperature == 0.0),
+                truncate=req.top_k < self.lm.vocab,
             )
             # Pad each block's (1, h, bucket, hd) K/V to the cache length
             # happens inside _insert via dynamic_update_slice bounds.
@@ -375,6 +411,7 @@ class ContinuousBatcher:
         pos = np.full((B,), self._trash, np.int32)
         keys = np.zeros((C, B, 2), np.uint32)
         temps = np.zeros((B,), np.float32)
+        top_ks = np.full((B,), self.lm.vocab, np.int32)
         greedy = np.ones((B,), bool)
         for i, slot in enumerate(self.slots):
             if slot.req is None:
@@ -389,6 +426,7 @@ class ContinuousBatcher:
             )
             keys[:, i, :] = slot.req.folded_keys[idx]
             temps[i] = slot.req.temperature
+            top_ks[i] = slot.req.top_k
             greedy[i] = slot.req.temperature == 0.0
         toks, self._caches = self._step_chunk(
             self.variables,
@@ -397,7 +435,9 @@ class ContinuousBatcher:
             jnp.asarray(pos),
             jnp.asarray(keys),
             jnp.asarray(temps),
+            jnp.asarray(top_ks),
             jnp.asarray(greedy),
+            truncate=bool((top_ks < self.lm.vocab).any()),
         )
         toks = np.asarray(toks)  # (C, B) — the chunk's ONE host sync
         for i, slot in enumerate(self.slots):

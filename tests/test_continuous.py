@@ -112,6 +112,32 @@ def test_more_requests_than_slots(lm_setup):
         )
 
 
+def test_per_request_top_k_matches_generate(lm_setup):
+    """Different top_k per request in ONE batch (traced per-row
+    truncation): each stream equals its own generate(top_k=...) solo."""
+    lm, variables = lm_setup
+    p1 = np.asarray([1, 2, 3], np.int32)
+    p2 = np.asarray([4, 5, 6, 7], np.int32)
+    p3 = np.asarray([8, 9], np.int32)
+    bat = ContinuousBatcher(lm, variables, slots=3)  # no default top_k
+    r1 = bat.submit(p1, 5, temperature=0.8, top_k=3,
+                    rng=jax.random.PRNGKey(21))
+    r2 = bat.submit(p2, 5, temperature=1.1, top_k=12,
+                    rng=jax.random.PRNGKey(22))
+    r3 = bat.submit(p3, 5, temperature=0.9,  # untruncated
+                    rng=jax.random.PRNGKey(23))
+    out = bat.run()
+    np.testing.assert_array_equal(
+        out[r1], _solo(lm, variables, p1, 5, temperature=0.8, top_k=3,
+                       rng=jax.random.PRNGKey(21)))
+    np.testing.assert_array_equal(
+        out[r2], _solo(lm, variables, p2, 5, temperature=1.1, top_k=12,
+                       rng=jax.random.PRNGKey(22)))
+    np.testing.assert_array_equal(
+        out[r3], _solo(lm, variables, p3, 5, temperature=0.9,
+                       rng=jax.random.PRNGKey(23)))
+
+
 def test_int8_slot_caches_match_generate_int8(lm_setup):
     """Quantized slot caches reproduce generate(kv_cache_dtype="int8")
     exactly — same absmax-per-vector scheme, so the only difference is
