@@ -384,6 +384,33 @@ def lm_tiny(vocab: int = 256, max_len: int = 64) -> TransformerLM:
     return transformer_lm(vocab, 64, 4, 4, 128, max_len, name="lm_tiny")
 
 
+def nucleus_filter(lg: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Top-p (nucleus) truncation with a TRACED p: keep the smallest
+    descending-probability prefix whose mass reaches ``top_p`` (the
+    crossing token inclusive; the top-1 token always survives, so the
+    filter can never empty a row). ``top_p`` is scalar or per-row (n,).
+    Costs one (n, V) sort — callers on hot paths gate it behind a
+    static use-flag like the top-k sort."""
+    sorted_desc = jnp.sort(lg, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    p = jnp.asarray(top_p)
+    if p.ndim:
+        p = p[:, None]
+    keep = (cum - probs) < p  # mass BEFORE this token still under p
+    # p == 1.0 must be an EXACT identity (no filtering): with peaked
+    # logits the f32 cumsum saturates at 1.0 before the tail, so
+    # (cum - probs) < 1.0 alone would drop tokens whose probability
+    # rounds below the cumsum's ulp — and a mixed batch sharing one
+    # compiled filter (continuous batching) would then diverge from the
+    # filter-free solo path.
+    keep = keep | (p >= 1.0)
+    kth = jnp.min(
+        jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(lg >= kth, lg, -jnp.inf)
+
+
 def sample_next_tokens(
     logits: jax.Array,
     key: jax.Array,
@@ -391,10 +418,13 @@ def sample_next_tokens(
     *,
     do_sample: bool,
     top_k: int | None,
+    top_p: jax.Array | float | None = None,
     row_offset: jax.Array | int = 0,
 ) -> jax.Array:
     """logits (n, V) -> (n,) token ids: greedy argmax, or sample from
-    ``softmax(logits / temperature)`` optionally truncated to ``top_k``.
+    ``softmax(logits / temperature)`` optionally truncated to ``top_k``
+    and/or the ``top_p`` nucleus (k first, then p — the usual serving
+    composition).
 
     Sampling keys are PER ROW — the step key folded with the row's
     *global* batch index (``row_offset + i``) — so any contiguous slice
@@ -411,6 +441,8 @@ def sample_next_tokens(
         # token on the serving hot path.
         kth = lax.top_k(lg, top_k)[0][:, -1:]
         lg = jnp.where(lg >= kth, lg, -jnp.inf)
+    if top_p is not None:
+        lg = nucleus_filter(lg, top_p)
     rows = row_offset + jnp.arange(lg.shape[0])
     keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, rows)
     return jax.vmap(jax.random.categorical)(keys, lg)
@@ -441,6 +473,7 @@ def validate_generate_args(
     rng: jax.Array | None,
     prompt_lengths: jax.Array | None,
     kv_cache_dtype: str,
+    top_p: float | None = None,
 ) -> tuple[jax.Array, jax.Array, bool]:
     """Shared request validation for :func:`generate` and the pipelined
     decoder: returns ``(lengths, rng, do_sample)`` with every constraint
@@ -461,6 +494,8 @@ def validate_generate_args(
         # lax.top_k with k > axis size fails at trace time with an opaque
         # XLA error; name the real constraint instead.
         raise ValueError(f"top_k {top_k} exceeds vocab size {lm.vocab}")
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if kv_cache_dtype not in ("native", "int8"):
         raise ValueError(
             f"kv_cache_dtype={kv_cache_dtype!r}: expected 'native' or 'int8'"
@@ -499,6 +534,7 @@ def generate(
     steps: int,
     temperature: float = 0.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     eos_id: int | None = None,
     rng: jax.Array | None = None,
     prompt_lengths: jax.Array | None = None,
@@ -526,18 +562,20 @@ def generate(
     Sampling: ``temperature=0`` (default) is greedy argmax and needs no
     ``rng``; ``temperature > 0`` samples from ``softmax(logits / T)``,
     optionally truncated to the ``top_k`` highest-probability tokens
-    (the standard serving knobs). ``eos_id`` makes a finished row emit
+    and/or the ``top_p`` nucleus (smallest probability mass >= p; k
+    then p when both are set — the standard serving knobs). ``eos_id``
+    makes a finished row emit
     ``eos_id`` forever after — scan length is static, so "stop" means
     "pad with EOS", the jit-friendly convention.
 
     Compilation: only the *shape* of the request is static (steps,
-    top_k, and the sample/eos on-off booleans); temperature and eos_id
-    are traced operands, so a server sweeping temperatures per request
-    reuses one compiled program.
+    top_k, and the sample/top_p/eos on-off booleans); temperature,
+    top_p, and eos_id are traced operands, so a server sweeping them
+    per request reuses one compiled program.
     """
     lengths, rng, do_sample = validate_generate_args(
         lm, prompt, steps, temperature, top_k, rng, prompt_lengths,
-        kv_cache_dtype,
+        kv_cache_dtype, top_p=top_p,
     )
     return _generate_impl(
         lm,
@@ -545,11 +583,15 @@ def generate(
         prompt,
         lengths,
         jnp.asarray(temperature, jnp.float32),
+        # top_p rides as a traced operand (servers sweep it per request
+        # without recompiling); use_top_p is the static on/off.
+        jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
         jnp.asarray(-1 if eos_id is None else eos_id, prompt.dtype),
         rng,
         steps=steps,
         do_sample=do_sample,
         top_k=top_k,
+        use_top_p=top_p is not None,
         use_eos=eos_id is not None,
         ragged=prompt_lengths is not None,
         kv_quant=kv_cache_dtype == "int8",
@@ -559,7 +601,8 @@ def generate(
 @partial(
     jax.jit,
     static_argnames=(
-        "lm", "steps", "do_sample", "top_k", "use_eos", "ragged", "kv_quant"
+        "lm", "steps", "do_sample", "top_k", "use_top_p", "use_eos",
+        "ragged", "kv_quant",
     ),
 )
 def _generate_impl(
@@ -568,12 +611,14 @@ def _generate_impl(
     prompt: jax.Array,
     lengths: jax.Array,
     temperature: jax.Array,
+    top_p: jax.Array,
     eos_id: jax.Array,
     rng: jax.Array,
     *,
     steps: int,
     do_sample: bool,
     top_k: int | None,
+    use_top_p: bool,
     use_eos: bool,
     ragged: bool,
     kv_quant: bool,
@@ -594,7 +639,8 @@ def _generate_impl(
         """logits (b, V) -> token ids (b,); per-row keys (see
         sample_next_tokens)."""
         return sample_next_tokens(
-            lg, key, temperature, do_sample=do_sample, top_k=top_k
+            lg, key, temperature, do_sample=do_sample, top_k=top_k,
+            top_p=top_p if use_top_p else None,
         )
 
     # ---- prefill ---------------------------------------------------------

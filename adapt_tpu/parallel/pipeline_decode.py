@@ -115,6 +115,7 @@ def pipelined_generate(
     axis: str = "pp",
     temperature: float = 0.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     eos_id: int | None = None,
     rng: jax.Array | None = None,
     prompt_lengths: jax.Array | None = None,
@@ -138,7 +139,7 @@ def pipelined_generate(
     b, _ = prompt.shape
     lengths, rng, do_sample = validate_generate_args(
         lm, prompt, steps, temperature, top_k, rng, prompt_lengths,
-        kv_cache_dtype,
+        kv_cache_dtype, top_p=top_p,
     )
     if lm.depth % num_ranks:
         raise ValueError(
@@ -159,11 +160,13 @@ def pipelined_generate(
         prompt,
         lengths,
         jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
         jnp.asarray(-1 if eos_id is None else eos_id, prompt.dtype),
         rng,
         steps=steps,
         do_sample=do_sample,
         top_k=top_k,
+        use_top_p=top_p is not None,
         use_eos=eos_id is not None,
         ragged=prompt_lengths is not None,
         kv_quant=kv_cache_dtype == "int8",
@@ -179,6 +182,7 @@ def pipelined_generate(
         "steps",
         "do_sample",
         "top_k",
+        "use_top_p",
         "use_eos",
         "ragged",
         "kv_quant",
@@ -194,12 +198,14 @@ def _pipelined_impl(
     prompt: jax.Array,
     lengths: jax.Array,
     temperature: jax.Array,
+    top_p: jax.Array,
     eos_id: jax.Array,
     rng: jax.Array,
     *,
     steps: int,
     do_sample: bool,
     top_k: int | None,
+    use_top_p: bool,
     use_eos: bool,
     ragged: bool,
     kv_quant: bool,
@@ -272,6 +278,7 @@ def _pipelined_impl(
             rep,  # vf_all
             rep,  # step_keys
             rep,  # temperature
+            rep,  # top_p
             rep,  # eos_id
         ),
         out_specs=rep,
@@ -288,6 +295,7 @@ def _pipelined_impl(
         vf_all,
         step_keys,
         temperature,
+        top_p,
         eos_id,
     ):
         rank = lax.axis_index(axis)
@@ -309,6 +317,7 @@ def _pipelined_impl(
                 temperature,
                 do_sample=do_sample,
                 top_k=top_k,
+                top_p=top_p if use_top_p else None,
                 row_offset=m * mb,
             ).astype(prompts_m.dtype)
             if use_eos:
@@ -497,6 +506,7 @@ def _pipelined_impl(
         vf_all,
         step_keys,
         temperature,
+        top_p,
         eos_id,
     )
     return toks.reshape(b, steps)

@@ -61,7 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from adapt_tpu.models.transformer_lm import TransformerLM
+from adapt_tpu.models.transformer_lm import TransformerLM, nucleus_filter
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
 
@@ -75,6 +75,7 @@ class _Request:
     steps: int
     temperature: float
     top_k: int  # == vocab -> no truncation
+    top_p: float  # == 1.0 -> no nucleus truncation
     eos_id: int | None
     folded_keys: np.ndarray  # (steps, 2) uint32 — pre-folded per-step keys
 
@@ -182,20 +183,20 @@ class ContinuousBatcher:
     @partial(
         jax.jit,
         static_argnums=(0,),
-        static_argnames=("truncate",),
+        static_argnames=("truncate", "nucleus"),
         donate_argnums=(2,),
     )
     def _step_chunk(self, variables, caches, tokens, pos, keys, temps,
-                    top_ks, greedy, *, truncate):
+                    top_ks, top_ps, greedy, *, truncate, nucleus):
         """``chunk`` lockstep decode steps as one compiled scan.
 
         tokens/pos: (B,) int32 — per-slot input token and cache position
         (inactive slots: trash). keys (chunk, B, 2) — each step's
-        per-slot sampling keys. temps (B,) / top_ks (B,) / greedy (B,)
-        select per-row sampling; static ``truncate`` elides the top-k
-        sort when no active request truncates (two compiled variants at
-        most). Returns ((chunk, B) emitted tokens, caches); ONE host
-        sync per call, not per token."""
+        per-slot sampling keys. temps / top_ks / top_ps / greedy (B,)
+        select per-row sampling; static ``truncate``/``nucleus`` elide
+        the top-k/top-p sorts when no active request needs them (at
+        most 2x2 compiled variants). Returns ((chunk, B) emitted
+        tokens, caches); ONE host sync per call, not per token."""
 
         def body(carry, step_keys):
             tokens, pos, caches = carry
@@ -217,6 +218,8 @@ class ContinuousBatcher:
             lg = logits / jnp.maximum(temps, 1e-6)[:, None]
             if truncate:
                 lg = self._truncate_rows(lg, top_ks)
+            if nucleus:
+                lg = nucleus_filter(lg, top_ps)
             pick_sampled = jax.vmap(jax.random.categorical)(step_keys, lg)
             nxt = jnp.where(greedy, pick_greedy, pick_sampled).astype(
                 tokens.dtype
@@ -235,9 +238,9 @@ class ContinuousBatcher:
         if bucket in self._prefill_cache:
             return self._prefill_cache[bucket]
 
-        @partial(jax.jit, static_argnames=("truncate",))
-        def prefill(variables, ids, true_len, keys, temp, top_k, greedy,
-                    *, truncate):
+        @partial(jax.jit, static_argnames=("truncate", "nucleus"))
+        def prefill(variables, ids, true_len, keys, temp, top_k, top_p,
+                    greedy, *, truncate, nucleus):
             h = self._embed.apply(variables["embed"], ids)
             kvs = []
             for name, block in zip(self.lm.block_names, self._blocks):
@@ -252,6 +255,8 @@ class ContinuousBatcher:
             lg = logits / jnp.maximum(temp, 1e-6)
             if truncate:
                 lg = self._truncate_rows(lg, top_k[None])
+            if nucleus:
+                lg = nucleus_filter(lg, top_p[None])
             sampled = jax.vmap(jax.random.categorical)(keys, lg)
             first = jnp.where(greedy, pick_greedy, sampled)
             return first, kvs
@@ -283,6 +288,7 @@ class ContinuousBatcher:
         steps: int,
         temperature: float = 0.0,
         top_k: int | None = None,
+        top_p: float | None = None,
         eos_id: int | None = None,
         rng: jax.Array | None = None,
     ) -> int:
@@ -316,6 +322,8 @@ class ContinuousBatcher:
             raise ValueError(
                 f"top_k {top_k_eff} outside [1, {self.lm.vocab}]"
             )
+        if top_p is not None and not (0.0 < top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         # generate()'s exact schedule: split -> key0 + per-step keys, each
         # folded with the row index (0 — solo semantics). One vmapped
         # dispatch + one host fetch, not O(steps) of them — this runs on
@@ -335,7 +343,16 @@ class ContinuousBatcher:
             prompt=prompt,
             steps=steps,
             temperature=float(temperature) if do_sample else 0.0,
-            top_k=top_k_eff if top_k_eff is not None else self.lm.vocab,
+            # Greedy requests discard the sampled pick entirely —
+            # normalize their knobs to the identity values so they never
+            # force the truncate/nucleus sorts (or variant recompiles)
+            # onto a tick.
+            top_k=(
+                top_k_eff
+                if do_sample and top_k_eff is not None
+                else self.lm.vocab
+            ),
+            top_p=top_p if do_sample and top_p is not None else 1.0,
             eos_id=eos_id,
             folded_keys=folded,
         )
@@ -381,8 +398,10 @@ class ContinuousBatcher:
                 jnp.asarray(req.folded_keys[0][None]),
                 jnp.asarray(req.temperature, jnp.float32),
                 jnp.asarray(req.top_k, jnp.int32),
+                jnp.asarray(req.top_p, jnp.float32),
                 jnp.asarray(req.temperature == 0.0),
                 truncate=req.top_k < self.lm.vocab,
+                nucleus=req.top_p < 1.0,
             )
             # Pad each block's (1, h, bucket, hd) K/V to the cache length
             # happens inside _insert via dynamic_update_slice bounds.
@@ -412,6 +431,7 @@ class ContinuousBatcher:
         keys = np.zeros((C, B, 2), np.uint32)
         temps = np.zeros((B,), np.float32)
         top_ks = np.full((B,), self.lm.vocab, np.int32)
+        top_ps = np.ones((B,), np.float32)
         greedy = np.ones((B,), bool)
         for i, slot in enumerate(self.slots):
             if slot.req is None:
@@ -427,6 +447,7 @@ class ContinuousBatcher:
             keys[:, i, :] = slot.req.folded_keys[idx]
             temps[i] = slot.req.temperature
             top_ks[i] = slot.req.top_k
+            top_ps[i] = slot.req.top_p
             greedy[i] = slot.req.temperature == 0.0
         toks, self._caches = self._step_chunk(
             self.variables,
@@ -436,8 +457,10 @@ class ContinuousBatcher:
             jnp.asarray(keys),
             jnp.asarray(temps),
             jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
             jnp.asarray(greedy),
             truncate=bool((top_ks < self.lm.vocab).any()),
+            nucleus=bool((top_ps < 1.0).any()),
         )
         toks = np.asarray(toks)  # (C, B) — the chunk's ONE host sync
         for i, slot in enumerate(self.slots):

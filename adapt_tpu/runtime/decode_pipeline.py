@@ -295,6 +295,7 @@ class PipelinedDecoder:
         steps: int,
         temperature: float = 0.0,
         top_k: int | None = None,
+        top_p: float | None = None,
         eos_id: int | None = None,
         rng: jax.Array | None = None,
         prompt_lengths: jax.Array | None = None,
@@ -316,7 +317,7 @@ class PipelinedDecoder:
         b, s0 = prompt.shape
         lengths, rng, do_sample = validate_generate_args(
             self.lm, prompt, steps, temperature, top_k, rng,
-            prompt_lengths, self.kv_cache_dtype,
+            prompt_lengths, self.kv_cache_dtype, top_p=top_p,
         )
         if prompt_lengths is not None:
             prompt, pos_ids, valid_from = _left_align(prompt, lengths)
@@ -369,7 +370,8 @@ class PipelinedDecoder:
             toks = np.asarray(
                 sample_next_tokens(
                     logits, key, temp,
-                    do_sample=do_sample, top_k=top_k, row_offset=m * mb,
+                    do_sample=do_sample, top_k=top_k, top_p=top_p,
+                    row_offset=m * mb,
                 )
             ).astype(token_dtype)
             if eos_id is not None:

@@ -167,3 +167,45 @@ def test_validation(lm_setup):
         bat.submit(np.asarray([1], np.int32), 2, temperature=0.5)
     with pytest.raises(ValueError, match="top_k"):
         ContinuousBatcher(lm, variables, slots=2, top_k=99)
+
+
+def test_no_top_p_request_unaffected_by_nucleus_neighbor(lm_setup):
+    """Regression: a sampled request WITHOUT top_p batched next to a
+    nucleus request flows through the shared filter with p=1.0 — which
+    must be an exact identity (f32 cumsum saturation once silently
+    dropped sub-ulp-probability tokens there), so its stream still
+    equals the filter-free solo generate()."""
+    lm, variables = lm_setup
+    p1 = np.asarray([7, 3, 1], np.int32)
+    p2 = np.asarray([2, 8], np.int32)
+    bat = ContinuousBatcher(lm, variables, slots=2)
+    r1 = bat.submit(p1, 6, temperature=1.4, rng=jax.random.PRNGKey(33))
+    r2 = bat.submit(p2, 6, temperature=0.8, top_p=0.5,
+                    rng=jax.random.PRNGKey(34))
+    out = bat.run()
+    np.testing.assert_array_equal(
+        out[r1], _solo(lm, variables, p1, 6, temperature=1.4,
+                       rng=jax.random.PRNGKey(33)))
+    np.testing.assert_array_equal(
+        out[r2], _solo(lm, variables, p2, 6, temperature=0.8, top_p=0.5,
+                       rng=jax.random.PRNGKey(34)))
+
+
+def test_per_request_top_p_matches_generate(lm_setup):
+    """Mixed nucleus-p traffic in one batch matches each request's own
+    generate(top_p=...) solo; a top_p=1.0 request rides the skip path."""
+    lm, variables = lm_setup
+    p1 = np.asarray([1, 5, 9], np.int32)
+    p2 = np.asarray([2, 4], np.int32)
+    bat = ContinuousBatcher(lm, variables, slots=2)
+    r1 = bat.submit(p1, 5, temperature=0.9, top_p=0.6,
+                    rng=jax.random.PRNGKey(31))
+    r2 = bat.submit(p2, 5, temperature=1.2, top_p=1.0,
+                    rng=jax.random.PRNGKey(32))
+    out = bat.run()
+    np.testing.assert_array_equal(
+        out[r1], _solo(lm, variables, p1, 5, temperature=0.9, top_p=0.6,
+                       rng=jax.random.PRNGKey(31)))
+    np.testing.assert_array_equal(
+        out[r2], _solo(lm, variables, p2, 5, temperature=1.2, top_p=1.0,
+                       rng=jax.random.PRNGKey(32)))

@@ -224,3 +224,14 @@ def test_rejects_bad_kv_dtype(devices, lm_setup):
             lm, variables, [2], devices=devices[:2], fault=FAST,
             kv_cache_dtype="int4",
         )
+
+
+def test_top_p_matches_generate(devices, lm_setup):
+    lm, variables, prompt = lm_setup
+    kw = dict(temperature=1.0, top_p=0.65, rng=jax.random.PRNGKey(43))
+    want = np.asarray(generate(lm, variables, prompt, 5, **kw))
+    with PipelinedDecoder(
+        lm, variables, [2], devices=devices[:3], fault=FAST
+    ) as dec:
+        got = dec.generate(prompt, 5, **kw)
+    np.testing.assert_array_equal(got, want)

@@ -427,3 +427,42 @@ def test_lm_generate_int8_kv_cache():
 
     with pytest.raises(ValueError, match="kv_cache_dtype"):
         generate(lm, variables, prompt, 2, kv_cache_dtype="fp8")
+
+
+def test_lm_generate_top_p():
+    """Nucleus sampling: top_p=1.0 filters nothing (stream identical to
+    the unfiltered sampler), top_p→0 degenerates to greedy (only the
+    top-1 token survives the nucleus), and mid-range p is deterministic
+    per key."""
+    from adapt_tpu.models.transformer_lm import generate, lm_tiny
+
+    lm = lm_tiny(vocab=29, max_len=24)
+    prompt = jax.random.randint(jax.random.PRNGKey(15), (2, 4), 0, 29)
+    variables = lm.graph.init(jax.random.PRNGKey(16), prompt)
+
+    base = np.asarray(
+        generate(lm, variables, prompt, 8, temperature=1.0,
+                 rng=jax.random.PRNGKey(17))
+    )
+    all_mass = np.asarray(
+        generate(lm, variables, prompt, 8, temperature=1.0, top_p=1.0,
+                 rng=jax.random.PRNGKey(17))
+    )
+    np.testing.assert_array_equal(base, all_mass)
+
+    greedy = np.asarray(generate(lm, variables, prompt, 8))
+    tiny_p = np.asarray(
+        generate(lm, variables, prompt, 8, temperature=1.7, top_p=1e-6,
+                 rng=jax.random.PRNGKey(18))
+    )
+    np.testing.assert_array_equal(greedy, tiny_p)
+
+    s1 = np.asarray(generate(lm, variables, prompt, 8, temperature=1.0,
+                             top_p=0.7, rng=jax.random.PRNGKey(19)))
+    s2 = np.asarray(generate(lm, variables, prompt, 8, temperature=1.0,
+                             top_p=0.7, rng=jax.random.PRNGKey(19)))
+    np.testing.assert_array_equal(s1, s2)
+
+    with pytest.raises(ValueError, match="top_p"):
+        generate(lm, variables, prompt, 2, temperature=1.0, top_p=1.5,
+                 rng=jax.random.PRNGKey(20))

@@ -138,3 +138,13 @@ def test_rejects_bad_divisibility(pp4, lm_and_vars):
     object.__setattr__(lm3, "depth", 3)
     with pytest.raises(ValueError, match="depth"):
         pipelined_generate(lm3, variables, prompt, 4, pp4)
+
+
+def test_top_p_matches_generate(pp4, lm_and_vars):
+    lm, variables, prompt = lm_and_vars
+    kw = dict(temperature=1.0, top_p=0.65, rng=jax.random.PRNGKey(41))
+    want = np.asarray(generate(lm, variables, prompt, 5, **kw))
+    got = np.asarray(
+        pipelined_generate(lm, variables, prompt, 5, pp4, **kw)
+    )
+    np.testing.assert_array_equal(got, want)
