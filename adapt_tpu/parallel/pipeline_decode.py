@@ -113,6 +113,7 @@ def pipelined_generate(
     steps: int,
     mesh: Mesh,
     axis: str = "pp",
+    dp_axis: str | None = None,
     temperature: float = 0.0,
     top_k: int | None = None,
     top_p: float | None = None,
@@ -134,8 +135,15 @@ def pipelined_generate(
     :class:`PipelinedVariables` from :func:`shard_for_pipeline` —
     serving, and any model too big for one chip, should pre-place once
     and reuse.
+
+    ``dp_axis`` composes data parallelism with the pipeline on a 2-D
+    mesh: every microbatch's rows shard over ``dp_axis`` (batch must
+    divide by pipeline_size * dp_size) while blocks + caches shard over
+    ``axis`` — sampling stays per-GLOBAL-row, so output is still
+    token-identical to single-program ``generate``.
     """
     num_ranks = mesh.shape[axis]
+    dp = mesh.shape[dp_axis] if dp_axis is not None else 1
     b, _ = prompt.shape
     lengths, rng, do_sample = validate_generate_args(
         lm, prompt, steps, temperature, top_k, rng, prompt_lengths,
@@ -149,6 +157,11 @@ def pipelined_generate(
         raise ValueError(
             f"batch {b} not divisible by pipeline size {num_ranks} "
             "(the microbatch split); pad the batch"
+        )
+    if (b // num_ranks) % dp:
+        raise ValueError(
+            f"per-microbatch rows {b // num_ranks} not divisible by "
+            f"dp size {dp}"
         )
     if not isinstance(variables, PipelinedVariables):
         variables = shard_for_pipeline(lm, variables, mesh, axis)
@@ -172,6 +185,7 @@ def pipelined_generate(
         kv_quant=kv_cache_dtype == "int8",
         mesh=mesh,
         axis=axis,
+        dp_axis=dp_axis,
     )
 
 
@@ -188,6 +202,7 @@ def pipelined_generate(
         "kv_quant",
         "mesh",
         "axis",
+        "dp_axis",
     ),
 )
 def _pipelined_impl(
@@ -211,12 +226,15 @@ def _pipelined_impl(
     kv_quant: bool,
     mesh: Mesh,
     axis: str,
+    dp_axis: str | None,
 ) -> jax.Array:
     g = lm.graph
     num_ranks = mesh.shape[axis]
     b, s0 = prompt.shape
     num_micro = num_ranks  # M == P: tight rotation, no idle ticks
-    mb = b // num_micro
+    mb = b // num_micro  # global rows per microbatch
+    dp = mesh.shape[dp_axis] if dp_axis is not None else 1
+    mb_loc = mb // dp  # rows this dp shard holds per microbatch
     local_blocks = lm.depth // num_ranks
     embed = g.node("embed").module
     head = g.node("head").module
@@ -252,7 +270,8 @@ def _pipelined_impl(
 
     def cache_buf(last_dim, dtype):
         return jnp.zeros(
-            (local_blocks, num_micro, mb, heads, cache_len, last_dim), dtype
+            (local_blocks, num_micro, mb_loc, heads, cache_len, last_dim),
+            dtype,
         )
 
     if kv_quant:
@@ -265,6 +284,10 @@ def _pipelined_impl(
     param_specs = jax.tree.map(lambda _: P(axis), stacked)
     rep = P()
     rep_tree = lambda t: jax.tree.map(lambda _: P(), t)  # noqa: E731
+    # Row-carrying operands shard their mb dim over dp (replicated when
+    # no dp axis).
+    rows3 = P(None, dp_axis, None) if dp_axis else rep
+    rows2 = P(None, dp_axis) if dp_axis else rep
 
     @partial(
         jax.shard_map,
@@ -273,15 +296,15 @@ def _pipelined_impl(
             param_specs,
             rep_tree(embed_vars),
             rep_tree(head_vars),
-            rep,  # prompts_m
-            rep,  # pos_all
-            rep,  # vf_all
+            rows3,  # prompts_m
+            rows3,  # pos_all
+            rows2,  # vf_all
             rep,  # step_keys
             rep,  # temperature
             rep,  # top_p
             rep,  # eos_id
         ),
-        out_specs=rep,
+        out_specs=rows3,
         # pallas_call outputs (the prefill flash dispatch) carry no vma
         # annotation — same reason as ulysses/ring flash.
         check_vma=False,
@@ -299,6 +322,9 @@ def _pipelined_impl(
         eos_id,
     ):
         rank = lax.axis_index(axis)
+        dp_off = (
+            lax.axis_index(dp_axis) * mb_loc if dp_axis is not None else 0
+        )
         is_last = rank == num_ranks - 1
         shift = [(i, i + 1) for i in range(num_ranks - 1)]
         ring = [(i, (i + 1) % num_ranks) for i in range(num_ranks)]
@@ -318,7 +344,9 @@ def _pipelined_impl(
                 do_sample=do_sample,
                 top_k=top_k,
                 top_p=top_p if use_top_p else None,
-                row_offset=m * mb,
+                # GLOBAL row index: microbatch base + this dp shard's
+                # offset — a slice samples what the full batch would.
+                row_offset=m * mb + dp_off,
             ).astype(prompts_m.dtype)
             if use_eos:
                 toks = jnp.where(done_m, eos_id, toks)
@@ -381,12 +409,12 @@ def _pipelined_impl(
             return (h_out, ck, cv, first, toks, done), None
 
         init = (
-            jnp.zeros((mb, s0, block.dim), block.dtype),
+            jnp.zeros((mb_loc, s0, block.dim), block.dtype),
             init_k,
             init_v,
-            jnp.zeros((num_micro, mb), prompts_m.dtype),  # first tokens
-            jnp.zeros((num_micro, mb, steps), prompts_m.dtype),
-            jnp.zeros((num_micro, mb), bool),
+            jnp.zeros((num_micro, mb_loc), prompts_m.dtype),  # first toks
+            jnp.zeros((num_micro, mb_loc, steps), prompts_m.dtype),
+            jnp.zeros((num_micro, mb_loc), bool),
         )
         (_, ck, cv, first, toks, done), _ = lax.scan(
             prefill_tick, init, jnp.arange(num_micro + num_ranks - 1)
@@ -489,7 +517,7 @@ def _pipelined_impl(
             h_next = jnp.where(is_last, emb_n, x_out)
             return (h_next, ck, cv, toks, done), None
 
-        init_h = jnp.zeros((mb, 1, block.dim), block.dtype)
+        init_h = jnp.zeros((mb_loc, 1, block.dim), block.dtype)
         (_, _, _, toks, _), _ = lax.scan(
             decode_tick,
             (init_h, ck, cv, toks, done),

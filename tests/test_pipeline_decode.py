@@ -148,3 +148,70 @@ def test_top_p_matches_generate(pp4, lm_and_vars):
         pipelined_generate(lm, variables, prompt, 5, pp4, **kw)
     )
     np.testing.assert_array_equal(got, want)
+
+
+def test_dp_pp_composition_matches_generate(devices):
+    """2-D mesh: batch rows shard over dp while blocks + caches shard
+    over pp. Sampling uses GLOBAL row indices, so the dp x pp program
+    still emits exactly the single-program stream — greedy and sampled,
+    dense and ragged."""
+    lm = lm_tiny(vocab=71, max_len=32)
+    mesh = Mesh(np.array(devices[:8]).reshape(2, 4), ("dp", "pp"))
+    prompt = jax.random.randint(jax.random.PRNGKey(50), (16, 5), 0, 71)
+    variables = lm.graph.init(jax.random.PRNGKey(51), prompt)
+
+    want = np.asarray(generate(lm, variables, prompt, 5))
+    got = np.asarray(
+        pipelined_generate(lm, variables, prompt, 5, mesh, dp_axis="dp")
+    )
+    np.testing.assert_array_equal(got, want)
+
+    kw = dict(temperature=0.9, top_k=11, rng=jax.random.PRNGKey(52))
+    want_s = np.asarray(generate(lm, variables, prompt, 4, **kw))
+    got_s = np.asarray(
+        pipelined_generate(
+            lm, variables, prompt, 4, mesh, dp_axis="dp", **kw
+        )
+    )
+    np.testing.assert_array_equal(got_s, want_s)
+
+    lens = jnp.asarray([2, 5, 3, 4] * 4)
+    want_r = np.asarray(
+        generate(lm, variables, prompt, 4, prompt_lengths=lens)
+    )
+    got_r = np.asarray(
+        pipelined_generate(
+            lm, variables, prompt, 4, mesh, dp_axis="dp",
+            prompt_lengths=lens,
+        )
+    )
+    np.testing.assert_array_equal(got_r, want_r)
+
+    # EOS latching and int8 caches carry row-state whose shapes changed
+    # under dp sharding (done masks, quant scale buffers) — pin them on
+    # the 2-D mesh too.
+    eos = int(want[0, 1])
+    want_e = np.asarray(generate(lm, variables, prompt, 5, eos_id=eos))
+    got_e = np.asarray(
+        pipelined_generate(
+            lm, variables, prompt, 5, mesh, dp_axis="dp", eos_id=eos
+        )
+    )
+    np.testing.assert_array_equal(got_e, want_e)
+
+    want_q = np.asarray(
+        generate(lm, variables, prompt, 4, kv_cache_dtype="int8")
+    )
+    got_q = np.asarray(
+        pipelined_generate(
+            lm, variables, prompt, 4, mesh, dp_axis="dp",
+            kv_cache_dtype="int8",
+        )
+    )
+    np.testing.assert_array_equal(got_q, want_q)
+
+    with pytest.raises(ValueError, match="dp size"):
+        # 12 rows: divisible by pp=4 (3 per microbatch) but 3 % dp=2 != 0.
+        pipelined_generate(
+            lm, variables, prompt[:12], 4, mesh, dp_axis="dp"
+        )
