@@ -14,10 +14,14 @@ overhead factor vs single-program on shared cores, reported as
 ``vs_baseline`` (pipelined/single tokens-per-sec, expect <= 1.0 on a
 virtual mesh; on P real chips the schedule's steady state runs one token
 per tick aggregate — the single-chip rate at P x the memory — which only
-hardware can demonstrate). Artifact: results/r04/pipelined_decode.json.
+hardware can demonstrate). ``--dp`` composes data parallelism on a 2-D
+(dp, pp) mesh (rows shard over dp, blocks+caches over pp).
 
-Usage: ``python benchmarks/pipelined_decode.py [--pp 4] [--batch 8]
-[--steps 32]``
+Artifact: ``results/r04/pipelined_decode.json`` for the default config,
+``results/r04/pipelined_decode_<tag>.json`` otherwise (tag = ppN[_dpM]).
+
+Usage: ``python benchmarks/pipelined_decode.py [--pp 4] [--dp 1]
+[--batch 8] [--steps 32]``
 """
 
 from __future__ import annotations
@@ -34,16 +38,31 @@ from benchmarks.common import int_flag  # noqa: E402  (imports no JAX)
 
 VOCAB, DIM, DEPTH, HEADS, MLP = 1024, 256, 8, 8, 1024
 PROMPT_LEN, MAX_LEN = 16, 128
-OUT = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), "results", "r04",
-    "pipelined_decode.json",
-)
+DEFAULT_PP, DEFAULT_DP = 4, 1
 
 
-def _child(pp: int, batch: int, steps: int, trials: int) -> None:
+def _tag(pp: int, dp: int) -> str:
+    """One tag shared by the child's metric and the parent's fallback
+    record + filename — a single source so they cannot disagree."""
+    return f"pp{pp}" + (f"_dp{dp}" if dp > 1 else "")
+
+
+def _out_path(tag: str) -> str:
+    # The default config keeps the legacy filename README cites.
+    name = (
+        "pipelined_decode.json"
+        if tag == _tag(DEFAULT_PP, DEFAULT_DP)
+        else f"pipelined_decode_{tag}.json"
+    )
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results", "r04", name
+    )
+
+
+def _child(pp: int, batch: int, steps: int, trials: int, dp: int) -> None:
     from benchmarks.common import force_cpu_mesh
 
-    force_cpu_mesh(pp)
+    force_cpu_mesh(pp * dp)
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -60,7 +79,19 @@ def _child(pp: int, batch: int, steps: int, trials: int) -> None:
         jax.random.PRNGKey(0), (batch, PROMPT_LEN), 0, VOCAB
     )
     variables = jax.jit(lm.graph.init)(jax.random.PRNGKey(1), prompt)
-    mesh = Mesh(np.array(jax.devices()[:pp]), ("pp",))
+    if dp > 1:
+        mesh = Mesh(
+            np.array(jax.devices()[: pp * dp]).reshape(dp, pp),
+            ("dp", "pp"),
+        )
+        dec = lambda v, p: pipelined_generate(  # noqa: E731
+            lm, v, p, steps, mesh, axis="pp", dp_axis="dp"
+        )
+    else:
+        mesh = Mesh(np.array(jax.devices()[:pp]), ("pp",))
+        dec = lambda v, p: pipelined_generate(  # noqa: E731
+            lm, v, p, steps, mesh
+        )
     # Pre-place once (the serving pattern): per-rank block slices +
     # replicated embed/head; the timed region is pure decode.
     placed = shard_for_pipeline(lm, variables, mesh)
@@ -78,17 +109,16 @@ def _child(pp: int, batch: int, steps: int, trials: int) -> None:
     single_out, single_s = timed(
         lambda p: generate(lm, variables, p, steps)
     )
-    piped_out, piped_s = timed(
-        lambda p: pipelined_generate(lm, placed, p, steps, mesh)
-    )
+    piped_out, piped_s = timed(lambda p: dec(placed, p))
     match = bool((single_out == piped_out).all())
 
     single_tok_s = batch * steps / single_s
     piped_tok_s = batch * steps / piped_s
+    tag = _tag(pp, dp)
     print(
         json.dumps(
             {
-                "metric": f"pipelined_decode_pp{pp}_tokens_per_sec",
+                "metric": f"pipelined_decode_{tag}_tokens_per_sec",
                 "value": round(piped_tok_s, 2),
                 "unit": "tokens/sec",
                 "vs_baseline": round(piped_tok_s / single_tok_s, 4),
@@ -99,7 +129,7 @@ def _child(pp: int, batch: int, steps: int, trials: int) -> None:
                 "platform": jax.devices()[0].platform,
                 "tokens_match_single_program": match,
                 "config": f"vocab{VOCAB} d{DIM} L{DEPTH} h{HEADS} "
-                f"prompt{PROMPT_LEN} steps{steps} bs{batch} pp{pp}",
+                f"prompt{PROMPT_LEN} steps{steps} bs{batch} {tag}",
                 "single_s": round(single_s, 4),
                 "pipelined_s": round(piped_s, 4),
             }
@@ -109,21 +139,23 @@ def _child(pp: int, batch: int, steps: int, trials: int) -> None:
 
 
 def main() -> int:
-    pp = int_flag(sys.argv, "--pp", 4)
+    pp = int_flag(sys.argv, "--pp", DEFAULT_PP)
+    dp = int_flag(sys.argv, "--dp", DEFAULT_DP)
     batch = int_flag(sys.argv, "--batch", 8)
     steps = int_flag(sys.argv, "--steps", 32)
     trials = int_flag(sys.argv, "--trials", 3)
     if "--child" in sys.argv:
-        _child(pp, batch, steps, trials)
+        _child(pp, batch, steps, trials, dp)
         return 0
 
     env = dict(os.environ)
     env.pop("PYTHONPATH", None)  # never dial the TPU relay for a CPU mesh
-    metric = f"pipelined_decode_pp{pp}_tokens_per_sec"
+    tag = _tag(pp, dp)
+    metric = f"pipelined_decode_{tag}_tokens_per_sec"
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child",
-             "--pp", str(pp), "--batch", str(batch),
+             "--pp", str(pp), "--dp", str(dp), "--batch", str(batch),
              "--steps", str(steps), "--trials", str(trials)],
             capture_output=True,
             text=True,
@@ -150,8 +182,9 @@ def main() -> int:
             "metric": metric, "value": 0.0, "unit": "tokens/sec",
             "vs_baseline": 0.0, "error": "child timed out after 1200s",
         }
-    os.makedirs(os.path.dirname(OUT), exist_ok=True)
-    with open(OUT, "w") as f:
+    out = _out_path(tag)
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
         json.dump(record, f, indent=1)
         f.write("\n")
     print(json.dumps(record), flush=True)
