@@ -162,6 +162,11 @@ class ContinuousBatcher:
         self._done: dict[int, np.ndarray] = {}
         self._next_id = 0
         self._prefill_cache: dict[int, Any] = {}  # bucket -> jitted fn
+        # Instance-lifetime counts (stats() must not read the PROCESS
+        # counters — two batchers would report each other's traffic).
+        self._admitted = 0
+        self._completed = 0
+        self._ticks = 0
 
     # -- compiled pieces ---------------------------------------------------
 
@@ -363,6 +368,7 @@ class ContinuousBatcher:
     def _finish(self, slot: _Slot) -> None:
         req = slot.req
         self._done[req.req_id] = np.asarray(slot.tokens, np.int32)
+        self._completed += 1
         global_metrics().inc("continuous.completed")
         slot.req = None
         slot.tokens = []
@@ -413,6 +419,7 @@ class ContinuousBatcher:
             slot.pos = s0
             slot.emitted = 0
             slot.tokens = []
+            self._admitted += 1
             global_metrics().inc("continuous.admitted")
             self._commit(slot, int(first[0]))
 
@@ -423,6 +430,10 @@ class ContinuousBatcher:
         (0 = fully idle)."""
         self._admit()
         active = [s for s in self.slots if s.req is not None]
+        # Gauges refresh BEFORE the idle early-return, or an empty
+        # batcher would scrape its last busy tick's values forever.
+        global_metrics().set_gauge("continuous.active_slots", len(active))
+        global_metrics().set_gauge("continuous.queue_depth", len(self._queue))
         if not active:
             return 0
         B, C = len(self.slots), self.chunk
@@ -462,6 +473,8 @@ class ContinuousBatcher:
             truncate=bool((top_ks < self.lm.vocab).any()),
             nucleus=bool((top_ps < 1.0).any()),
         )
+        self._ticks += 1
+        global_metrics().inc("continuous.ticks")
         toks = np.asarray(toks)  # (C, B) — the chunk's ONE host sync
         for i, slot in enumerate(self.slots):
             if slot.req is None:
@@ -475,7 +488,27 @@ class ContinuousBatcher:
                 # pos invariant at tick entry: the next step consumes
                 # last_token (stream index emitted-1) at s0 + emitted - 1.
                 slot.pos = slot.s0 + slot.emitted - 1
+        # Post-commit occupancy: slots retired by this chunk are gone.
+        global_metrics().set_gauge(
+            "continuous.active_slots",
+            sum(1 for sl in self.slots if sl.req is not None),
+        )
         return len(active)
+
+    def stats(self) -> dict:
+        """Serving observability snapshot: slot occupancy, queue depth,
+        and THIS batcher's lifetime admit/complete/tick counts
+        (instance-scoped — mirror counters also land in
+        ``utils.metrics.global_metrics`` for process-level scraping)."""
+        return {
+            "slots": len(self.slots),
+            "active": sum(1 for s in self.slots if s.req is not None),
+            "queued": len(self._queue),
+            "finished_unclaimed": len(self._done),
+            "admitted": self._admitted,
+            "completed": self._completed,
+            "ticks": self._ticks,
+        }
 
     def run(self, max_ticks: int = 100_000) -> dict[int, np.ndarray]:
         """Tick until every submitted request completed; returns

@@ -209,3 +209,39 @@ def test_per_request_top_p_matches_generate(lm_setup):
     np.testing.assert_array_equal(
         out[r2], _solo(lm, variables, p2, 5, temperature=1.2, top_p=1.0,
                        rng=jax.random.PRNGKey(32)))
+
+
+def test_stats_and_metrics(lm_setup):
+    """Serving observability: occupancy/queue stats and the global
+    counters move as traffic flows."""
+    from adapt_tpu.utils.metrics import global_metrics
+
+    lm, variables = lm_setup
+    global_metrics().reset()
+    bat = ContinuousBatcher(lm, variables, slots=2, chunk=2)
+    s = bat.stats()
+    assert s["slots"] == 2 and s["active"] == 0 and s["queued"] == 0
+    for i in range(3):
+        bat.submit(np.asarray([1 + i, 2, 3], np.int32), 4)
+    assert bat.stats()["queued"] == 3
+    bat.tick()
+    mid = bat.stats()
+    assert mid["active"] >= 1 and mid["admitted"] >= 2
+    bat.run()
+    end = bat.stats()
+    assert end["active"] == 0 and end["completed"] == 3
+    assert end["ticks"] >= 1 and end["finished_unclaimed"] == 0
+
+
+def test_stats_are_instance_scoped(lm_setup):
+    """Two batchers in one process must not report each other's traffic
+    (stats() reads instance counters, not the process registry)."""
+    lm, variables = lm_setup
+    a = ContinuousBatcher(lm, variables, slots=2)
+    a.submit(np.asarray([1, 2], np.int32), 3)
+    a.run()
+    b = ContinuousBatcher(lm, variables, slots=2)
+    sb = b.stats()
+    assert sb["admitted"] == 0 and sb["completed"] == 0 and sb["ticks"] == 0
+    sa = a.stats()
+    assert sa["admitted"] == 1 and sa["completed"] == 1
