@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import threading
 from functools import partial
 from typing import Any
 
@@ -167,6 +168,13 @@ class ContinuousBatcher:
         self._admitted = 0
         self._completed = 0
         self._ticks = 0
+        # Threaded serving (start()/result()/stop()): one condition
+        # guards every mutation of the queue/done handoff state and the
+        # server-thread lifecycle; compiled work runs outside the lock,
+        # on the server thread only.
+        self._cv = threading.Condition()
+        self._server: threading.Thread | None = None
+        self._stopping = False
 
     # -- compiled pieces ---------------------------------------------------
 
@@ -343,8 +351,11 @@ class ContinuousBatcher:
         folded = np.asarray(
             jax.vmap(jax.random.fold_in, in_axes=(0, None))(step_keys, 0)
         )
+        with self._cv:
+            req_id = self._next_id
+            self._next_id += 1
         req = _Request(
-            req_id=self._next_id,
+            req_id=req_id,
             prompt=prompt,
             steps=steps,
             temperature=float(temperature) if do_sample else 0.0,
@@ -361,13 +372,16 @@ class ContinuousBatcher:
             eos_id=eos_id,
             folded_keys=folded,
         )
-        self._next_id += 1
-        self._queue.append(req)
+        with self._cv:
+            self._queue.append(req)
+            self._cv.notify_all()  # wake the server thread, if any
         return req.req_id
 
     def _finish(self, slot: _Slot) -> None:
         req = slot.req
-        self._done[req.req_id] = np.asarray(slot.tokens, np.int32)
+        with self._cv:
+            self._done[req.req_id] = np.asarray(slot.tokens, np.int32)
+            self._cv.notify_all()  # result() waiters
         self._completed += 1
         global_metrics().inc("continuous.completed")
         slot.req = None
@@ -390,9 +404,12 @@ class ContinuousBatcher:
 
     def _admit(self) -> None:
         for i, slot in enumerate(self.slots):
-            if slot.req is not None or not self._queue:
+            if slot.req is not None:
                 continue
-            req = self._queue.popleft()
+            with self._cv:
+                if not self._queue:
+                    continue
+                req = self._queue.popleft()
             s0 = req.prompt.shape[0]
             bucket = next(b for b in self.prompt_buckets if b >= s0)
             ids = np.zeros((1, bucket), np.int32)
@@ -512,7 +529,8 @@ class ContinuousBatcher:
 
     def run(self, max_ticks: int = 100_000) -> dict[int, np.ndarray]:
         """Tick until every submitted request completed; returns
-        {req_id: (tokens,) int32} and clears the finished set."""
+        {req_id: (tokens,) int32} and clears the finished set. The
+        synchronous driver — do not mix with :meth:`start`."""
         ticks = 0
         while self._queue or any(s.req is not None for s in self.slots):
             self.tick()
@@ -521,3 +539,82 @@ class ContinuousBatcher:
                 raise RuntimeError(f"run() exceeded {max_ticks} ticks")
         done, self._done = self._done, {}
         return done
+
+    # -- threaded serving --------------------------------------------------
+
+    def start(self) -> "ContinuousBatcher":
+        """Serve on a background thread: callers :meth:`submit` from any
+        thread and block on :meth:`result`. All compiled work runs on
+        the server thread; the condition variable only guards the
+        queue/done handoff."""
+        with self._cv:
+            if self._server is not None:
+                raise RuntimeError("batcher already started")
+            self._stopping = False
+            # Reserve the slot under the lock so a concurrent start()
+            # cannot also pass the guard; the thread object replaces
+            # the placeholder below.
+            self._server = threading.current_thread()  # placeholder
+
+        def loop():
+            while True:
+                with self._cv:
+                    while (
+                        not self._stopping
+                        and not self._queue
+                        and all(s.req is None for s in self.slots)
+                    ):
+                        self._cv.wait(timeout=0.1)
+                    if self._stopping:
+                        return
+                self.tick()
+                with self._cv:
+                    self._cv.notify_all()  # results may have landed
+
+        server = threading.Thread(
+            target=loop, name="continuous-batcher", daemon=True
+        )
+        with self._cv:
+            self._server = server
+        server.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cv:
+            server = self._server
+            if server is None:
+                return
+            self._stopping = True
+            self._cv.notify_all()
+        server.join(timeout=30.0)
+        if server.is_alive():
+            # A tick stuck in a long compile/stall: forgetting the
+            # thread here would let a later start() run TWO tickers over
+            # the same donated caches. Keep it registered and fail loud.
+            raise RuntimeError(
+                "batcher server thread did not stop within 30s "
+                "(stuck tick?); retry stop()"
+            )
+        with self._cv:
+            self._server = None
+
+    def __enter__(self) -> "ContinuousBatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def result(self, req_id: int, timeout: float = 300.0) -> np.ndarray:
+        """Block until ``req_id`` finishes (requires :meth:`start`);
+        returns and claims its tokens."""
+        with self._cv:
+            if not self._cv.wait_for(
+                lambda: req_id in self._done or self._stopping,
+                timeout=timeout,
+            ):
+                raise TimeoutError(
+                    f"request {req_id} not done within {timeout}s"
+                )
+            if req_id not in self._done:
+                raise RuntimeError("batcher stopped before completion")
+            return self._done.pop(req_id)

@@ -245,3 +245,29 @@ def test_stats_are_instance_scoped(lm_setup):
     assert sb["admitted"] == 0 and sb["completed"] == 0 and sb["ticks"] == 0
     sa = a.stats()
     assert sa["admitted"] == 1 and sa["completed"] == 1
+
+
+def test_threaded_serving_matches_generate(lm_setup):
+    """start()/result(): submit from the caller thread while the server
+    thread ticks; every stream still equals its solo generate()."""
+    lm, variables = lm_setup
+    rng = np.random.RandomState(9)
+    with ContinuousBatcher(lm, variables, slots=2, chunk=4) as bat:
+        reqs = []
+        for i in range(5):
+            p = rng.randint(0, 37, size=rng.randint(2, 8)).astype(np.int32)
+            kw = (
+                dict(temperature=0.9, top_k=7,
+                     rng=jax.random.PRNGKey(60 + i))
+                if i % 2
+                else {}
+            )
+            reqs.append((bat.submit(p, 4 + i, **kw), p, 4 + i, kw))
+        for rid, p, steps, kw in reqs:
+            got = bat.result(rid, timeout=120.0)
+            np.testing.assert_array_equal(
+                got, _solo(lm, variables, p, steps, **kw)
+            )
+    # stopped: a late result() raises rather than hanging
+    with pytest.raises((RuntimeError, TimeoutError)):
+        bat.result(10_000, timeout=0.2)
