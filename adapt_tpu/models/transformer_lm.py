@@ -99,11 +99,14 @@ class CausalSelfAttention(nn.Module):
         to the O(S^2) oracle.
 
         ``quantize_cache`` stores the cache int8 (one absmax scale per
-        key/value vector): decode streams the whole cache from HBM every
-        step, so fewer cache bytes is less traffic on the bandwidth-bound
-        path — ~2x vs bf16 caches, 4x vs f32 (and the same factor more
-        context per chip). Caches become ``(int8 values, f32 scales)``
-        pairs."""
+        key/value vector). This is a CONTEXT-CAPACITY feature, not a
+        speed feature: cache bytes drop ~1.9x vs bf16 (measured
+        603,979,776 -> 320,864,256 at bs8/2k, so ~1.9x more context per
+        chip), but the hardware A/B (r04 `lm_decode_long_{native,int8}`)
+        measured decode ~12% SLOWER (1,964 vs 2,226 tok/s at 2k context,
+        GPT-2-small) — XLA does not fuse the per-step dequant for free,
+        so the bandwidth saving does not show up as throughput at this
+        size. Caches become ``(int8 values, f32 scales)`` pairs."""
         b, s, d = x.shape
         q, k, v = self._project(x)
         o = flash_attention(q, k, v, causal=True, valid_from=valid_from)
@@ -553,11 +556,12 @@ def generate(
     at ITS OWN continuation, exactly as if it had been generated alone.
 
     ``kv_cache_dtype="int8"`` stores the KV cache quantized (absmax
-    int8 per key/value vector): decode re-reads the whole cache from
-    HBM every step, so this cuts the bandwidth-bound cache traffic
-    (~2x vs bf16 caches, 4x vs f32) and fits the same factor more
-    context per chip, at a small logits perturbation (tested against
-    the native-cache path).
+    int8 per key/value vector): ~1.9x fewer cache bytes than bf16, so
+    ~1.9x more context fits per chip, at a small logits perturbation
+    (tested against the native-cache path). Use it for CAPACITY, not
+    speed — the hardware A/B measured decode ~12% slower than the
+    native cache at 2k context (see ``prefill``'s docstring and
+    ``benchmarks/results/r04/lm_decode_long_*.json``).
 
     Sampling: ``temperature=0`` (default) is greedy argmax and needs no
     ``rng``; ``temperature > 0`` samples from ``softmax(logits / T)``,
