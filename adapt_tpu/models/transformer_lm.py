@@ -39,12 +39,28 @@ _NEG_INF = -1e30
 
 
 class CausalSelfAttention(nn.Module):
-    """Causal MHA sharing weights between the full-sequence path (flash
-    dispatch) and the single-token cached path."""
+    """Causal MHA/GQA sharing weights between the full-sequence path
+    (flash dispatch) and the single-token cached path.
+
+    ``kv_heads`` (grouped-query attention): project K/V to fewer heads
+    than Q and let each group of ``heads // kv_heads`` query heads share
+    one K/V head. The KV cache — the thing decode streams from HBM every
+    step and the thing that caps context per chip — shrinks by that same
+    factor, composing multiplicatively with the int8 cache option.
+    ``kv_heads=1`` is multi-query attention. ``kv_heads=None`` (or ==
+    ``heads``) keeps the fused-QKV MHA parameter structure byte-for-byte
+    so existing checkpoints and tests are untouched.
+
+    Head-group convention everywhere (full path, decode, verify): query
+    head ``i`` uses KV head ``i // group`` — adjacent query heads share.
+    The decode/verify paths never materialize repeated K/V: query heads
+    fold into extra query ROWS over the (b, kv_heads, L, hd) cache, so
+    the HBM traffic is the small cache, not a broadcast copy."""
 
     dim: int
     heads: int
     dtype: jnp.dtype = jnp.float32
+    kv_heads: int | None = None
 
     def setup(self):
         if self.dim % self.heads:
@@ -52,21 +68,86 @@ class CausalSelfAttention(nn.Module):
                 f"model dim {self.dim} not divisible by {self.heads} heads"
             )
         head_dim = self.dim // self.heads
-        self.qkv = nn.DenseGeneral(
-            (3, self.heads, head_dim), dtype=self.dtype, name="qkv"
-        )
+        kvh = self.kv_heads
+        if kvh is not None:
+            if not 1 <= kvh <= self.heads:
+                raise ValueError(
+                    f"kv_heads {kvh} outside [1, heads={self.heads}]"
+                )
+            if self.heads % kvh:
+                raise ValueError(
+                    f"heads {self.heads} not divisible by kv_heads {kvh}"
+                )
+        if self._group == 1:
+            # MHA: one fused projection (unchanged param structure).
+            self.qkv = nn.DenseGeneral(
+                (3, self.heads, head_dim), dtype=self.dtype, name="qkv"
+            )
+        else:
+            self.q_proj = nn.DenseGeneral(
+                (self.heads, head_dim), dtype=self.dtype, name="q"
+            )
+            self.kv_proj = nn.DenseGeneral(
+                (2, kvh, head_dim), dtype=self.dtype, name="kv"
+            )
         self.out = nn.Dense(self.dim, dtype=self.dtype, name="out")
 
+    @property
+    def _group(self) -> int:
+        """Query heads per KV head (1 = plain MHA)."""
+        return self.heads // (self.kv_heads or self.heads)
+
+    @property
+    def cache_heads(self) -> int:
+        """Head count of K/V cache buffers — kv_heads under GQA, heads
+        otherwise. External cache allocators MUST use this (not
+        ``heads``) or GQA models get heads-sized buffers and shape
+        errors at runtime."""
+        return self.kv_heads or self.heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
     def _project(self, x):
-        qkv = self.qkv(x)  # (b, s, 3, h, hd)
-        q, k, v = jnp.moveaxis(qkv, 2, 0)
-        # -> (b, h, s, hd)
+        """-> q (b, h, s, hd); k, v (b, kv_h, s, hd) (kv_h == h for
+        MHA)."""
+        if self._group == 1:
+            qkv = self.qkv(x)  # (b, s, 3, h, hd)
+            q, k, v = jnp.moveaxis(qkv, 2, 0)
+        else:
+            q = self.q_proj(x)  # (b, s, h, hd)
+            k, v = jnp.moveaxis(self.kv_proj(x), 2, 0)  # (b, s, kv_h, hd)
+        # -> (b, heads-axis, s, hd)
         return tuple(jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+
+    def _repeat_kv(self, t):
+        """Expand (b, kv_h, s, hd) -> (b, h, s, hd) for the full-sequence
+        flash path: repeat is adjacent-block so query head i lines up
+        with KV head i // group."""
+        g = self._group
+        return t if g == 1 else jnp.repeat(t, g, axis=1)
+
+    def _group_q(self, q):
+        """Fold query-head groups into query rows: (b, h, s, hd) ->
+        (b, kv_h, g*s, hd), row index = group_member * s + position —
+        the cached-path attention then runs against the UN-repeated
+        (b, kv_h, L, hd) cache with identical einsums."""
+        b, h, s, hd = q.shape
+        g = self._group
+        return q.reshape(b, h // g, g * s, hd)
+
+    def _ungroup_o(self, o, s):
+        """Inverse of ``_group_q`` on the attention output."""
+        b, kvh, gs, hd = o.shape
+        return o.reshape(b, kvh * (gs // s), s, hd)
 
     def __call__(self, x):
         b, s, d = x.shape
         q, k, v = self._project(x)
-        o = flash_attention(q, k, v, causal=True)
+        o = flash_attention(
+            q, self._repeat_kv(k), self._repeat_kv(v), causal=True
+        )
         return self.out(jnp.swapaxes(o, 1, 2).reshape(b, s, d))
 
     @staticmethod
@@ -109,7 +190,10 @@ class CausalSelfAttention(nn.Module):
         size. Caches become ``(int8 values, f32 scales)`` pairs."""
         b, s, d = x.shape
         q, k, v = self._project(x)
-        o = flash_attention(q, k, v, causal=True, valid_from=valid_from)
+        o = flash_attention(
+            q, self._repeat_kv(k), self._repeat_kv(v),
+            causal=True, valid_from=valid_from,
+        )
         pad = ((0, 0), (0, 0), (0, max_len - s), (0, 0))
         out = self.out(jnp.swapaxes(o, 1, 2).reshape(b, s, d))
         if quantize_cache:
@@ -148,7 +232,10 @@ class CausalSelfAttention(nn.Module):
         scales)`` pairs (see ``prefill``); the dequantize multiplies
         fuse into the attention matmuls."""
         b = x_t.shape[0]
-        q, k, v = self._project(x_t)  # each (b, h, 1, hd)
+        q, k, v = self._project(x_t)  # q (b, h, 1, hd); k/v (b, kv_h, 1, hd)
+        # GQA: fold query-head groups into query rows so the einsums
+        # below run unchanged against the small (b, kv_h, L, hd) cache.
+        q = self._group_q(q)  # (b, kv_h, g, hd)
         sm = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
         if quantized:
             (kvl, ksc), (vvl, vsc) = cache_k, cache_v
@@ -200,6 +287,7 @@ class CausalSelfAttention(nn.Module):
             o = jnp.einsum(
                 "bhqk,bhkd->bhqd", p, cache_v.astype(jnp.float32)
             ).astype(x_t.dtype)
+        o = self._ungroup_o(o, 1)  # (b, h, 1, hd)
         o = jnp.swapaxes(o, 1, 2).reshape(b, 1, self.dim)
         return self.out(o), cache_k, cache_v
 
@@ -215,7 +303,8 @@ class CausalSelfAttention(nn.Module):
         rollback — the position mask simply never admits them (the same
         trash-slot discipline the continuous batcher uses)."""
         b, kc, d = x.shape
-        q, k, v = self._project(x)  # each (b, h, K, hd)
+        q, k, v = self._project(x)  # q (b, h, K, hd); k/v (b, kv_h, K, hd)
+        q = self._group_q(q)  # (b, kv_h, g*K, hd), row = member*K + pos
         sm = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
         cache_k = lax.dynamic_update_slice(cache_k, k, (0, 0, index, 0))
         cache_v = lax.dynamic_update_slice(cache_v, v, (0, 0, index, 0))
@@ -226,15 +315,17 @@ class CausalSelfAttention(nn.Module):
                 cache_k.astype(jnp.float32),
             )
             * sm
-        )  # (b, h, K, cache_len)
+        )  # (b, kv_h, g*K, cache_len)
         positions = jnp.arange(cache_k.shape[2])
         rows = jnp.arange(kc)
         live = positions[None, :] <= (index + rows)[:, None]  # (K, L)
+        live = jnp.tile(live, (self._group, 1))  # (g*K, L), K-major per member
         s = jnp.where(live[None, None], s, _NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum(
             "bhqk,bhkd->bhqd", p, cache_v.astype(jnp.float32)
         ).astype(x.dtype)
+        o = self._ungroup_o(o, kc)  # (b, h, K, hd)
         o = jnp.swapaxes(o, 1, 2).reshape(b, kc, self.dim)
         return self.out(o), cache_k, cache_v
 
@@ -248,11 +339,21 @@ class DecoderBlock(nn.Module):
     heads: int
     mlp_dim: int
     dtype: jnp.dtype = jnp.float32
+    kv_heads: int | None = None
+
+    @property
+    def cache_heads(self) -> int:
+        """Cache-buffer head count (see ``CausalSelfAttention.cache_heads``)."""
+        return self.kv_heads or self.heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
 
     def setup(self):
         self.ln1 = nn.LayerNorm(dtype=self.dtype)
         self.attn = CausalSelfAttention(
-            self.dim, self.heads, dtype=self.dtype
+            self.dim, self.heads, dtype=self.dtype, kv_heads=self.kv_heads
         )
         self.ln2 = nn.LayerNorm(dtype=self.dtype)
         self.mlp_in = nn.Dense(self.mlp_dim, dtype=self.dtype)
@@ -367,7 +468,11 @@ def transformer_lm(
     max_len: int = 1024,
     dtype: jnp.dtype = jnp.float32,
     name: str = "transformer_lm",
+    kv_heads: int | None = None,
 ) -> TransformerLM:
+    """``kv_heads < heads`` builds a grouped-query (GQA) decoder: KV
+    caches shrink by ``heads // kv_heads`` (``kv_heads=1`` = MQA), the
+    serving-era cache-capacity knob — see ``CausalSelfAttention``."""
     g = LayerGraph(name)
     prev = g.add(
         "embed", TokenEmbed(vocab, dim, max_len, dtype=dtype), INPUT
@@ -375,7 +480,8 @@ def transformer_lm(
     for i in range(depth):
         prev = g.add(
             f"decoder_block_{i}",
-            DecoderBlock(dim, heads, mlp_dim, dtype=dtype),
+            DecoderBlock(dim, heads, mlp_dim, dtype=dtype,
+                         kv_heads=kv_heads),
             prev,
         )
     g.add("head", LMHead(vocab, dtype=dtype), prev)

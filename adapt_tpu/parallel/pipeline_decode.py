@@ -240,8 +240,8 @@ def _pipelined_impl(
     head = g.node("head").module
     block = g.node(lm.block_names[0]).module  # identical block structure
 
-    heads = block.heads
-    head_dim = block.dim // heads
+    # Cache buffers hold KV heads — fewer than query heads under GQA.
+    heads, head_dim = block.cache_heads, block.head_dim
     # One extra slot: bubble ticks write their garbage K/V here instead of
     # forcing a full-slice select per tick. `positions <= index` masking
     # keeps it out of every valid pass's attention window.

@@ -144,7 +144,9 @@ class ContinuousBatcher:
         block0 = self._blocks[0]
         self._cache_len = lm.max_len + 1  # one trash slot for idle rows
         self._trash = lm.max_len
-        heads, head_dim = block0.heads, block0.dim // block0.heads
+        # Slot caches hold KV heads: fewer than query heads under GQA
+        # (the whole point — slots cost kv_heads/heads the HBM).
+        heads, head_dim = block0.cache_heads, block0.head_dim
 
         def one_cache():
             if self._kv_quant:

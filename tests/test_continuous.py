@@ -271,3 +271,31 @@ def test_threaded_serving_matches_generate(lm_setup):
     # stopped: a late result() raises rather than hanging
     with pytest.raises((RuntimeError, TimeoutError)):
         bat.result(10_000, timeout=0.2)
+
+
+def test_gqa_requests_match_generate():
+    """A GQA model serves through the batcher: slot caches allocate the
+    smaller kv_heads layout and every stream still matches its solo
+    generate()."""
+    from adapt_tpu.models.transformer_lm import transformer_lm
+
+    vocab = 31
+    lm = transformer_lm(vocab=vocab, dim=32, depth=2, heads=4, mlp_dim=48,
+                        max_len=48, kv_heads=2)
+    variables = lm.graph.init(
+        jax.random.PRNGKey(50), jnp.zeros((1, 4), jnp.int32)
+    )
+    rng = np.random.RandomState(51)
+    prompts = [rng.randint(0, vocab, size=n).astype(np.int32)
+               for n in (3, 7, 5)]
+    steps = [6, 4, 5]
+
+    bat = ContinuousBatcher(lm, variables, slots=2, chunk=1)
+    # 2 kv heads, head_dim 8, max_len+1 cache rows.
+    assert bat._caches[0][0].shape == (2, 2, 49, 8)
+    ids = {bat.submit(p, s): i
+           for i, (p, s) in enumerate(zip(prompts, steps))}
+    out = bat.run()
+    for rid, i in ids.items():
+        want = _solo(lm, variables, prompts[i], steps[i])
+        np.testing.assert_array_equal(out[rid], want, err_msg=f"req {i}")

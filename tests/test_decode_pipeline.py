@@ -235,3 +235,21 @@ def test_top_p_matches_generate(devices, lm_setup):
     ) as dec:
         got = dec.generate(prompt, 5, **kw)
     np.testing.assert_array_equal(got, want)
+
+
+def test_gqa_matches_generate(devices):
+    """GQA decode sessions: stage workers hold the smaller kv_heads
+    caches; tokens (and replay-based recovery state) match generate()."""
+    from adapt_tpu.models.transformer_lm import transformer_lm
+
+    vocab = 37
+    lm = transformer_lm(vocab=vocab, dim=32, depth=2, heads=4, mlp_dim=48,
+                        max_len=32, kv_heads=2)
+    prompt = jax.random.randint(jax.random.PRNGKey(80), (2, 5), 0, vocab)
+    variables = lm.graph.init(jax.random.PRNGKey(81), prompt)
+    want = np.asarray(generate(lm, variables, prompt, 6))
+    with PipelinedDecoder(
+        lm, variables, [1], devices=devices[:3], fault=FAST
+    ) as dec:
+        got = dec.generate(prompt, 6)
+    np.testing.assert_array_equal(got, want)

@@ -215,3 +215,20 @@ def test_dp_pp_composition_matches_generate(devices):
         pipelined_generate(
             lm, variables, prompt[:12], 4, mesh, dp_axis="dp"
         )
+
+def test_gqa_matches_generate(pp4):
+    """A GQA model decodes through the pipeline: rank-local cache
+    buffers carry the smaller kv_heads layout, tokens still match
+    single-program generate()."""
+    from adapt_tpu.models.transformer_lm import transformer_lm
+
+    vocab = 53
+    lm = transformer_lm(vocab=vocab, dim=32, depth=4, heads=4, mlp_dim=48,
+                        max_len=32, kv_heads=2)
+    prompt = jax.random.randint(jax.random.PRNGKey(60), (4, 5), 0, vocab)
+    variables = lm.graph.init(jax.random.PRNGKey(61), prompt)
+    want = np.asarray(generate(lm, variables, prompt, 6))
+    got = np.asarray(
+        pipelined_generate(lm, variables, prompt, 6, pp4)
+    )
+    np.testing.assert_array_equal(got, want)

@@ -101,3 +101,21 @@ def test_validation(big_setup, draft_setup):
         speculative_generate(lm, variables, prompt, 4, other, ovars)
     with pytest.raises(ValueError, match="draft_k"):
         speculative_generate(lm, variables, prompt, 4, draft, dvars, draft_k=0)
+
+
+def test_gqa_target_lossless(draft_setup):
+    """Speculative decoding against a GQA target: verify_chunk's grouped
+    query rows over the small cache must stay lossless vs generate()."""
+    vocab = 41
+    lm = transformer_lm(vocab, 32, 2, 4, 64, max_len=48, kv_heads=2)
+    variables = lm.graph.init(
+        jax.random.PRNGKey(70), jnp.zeros((1, 4), jnp.int32)
+    )
+    prompt = jax.random.randint(jax.random.PRNGKey(71), (1, 5), 0, vocab)
+    want = np.asarray(generate(lm, variables, prompt, 8))
+    out, stats = speculative_generate(
+        lm, variables, prompt, 8, draft_lm=lm, draft_variables=variables,
+        draft_k=3, return_stats=True,
+    )
+    np.testing.assert_array_equal(np.asarray(out), want)
+    assert stats["drafted"] > 0
