@@ -104,6 +104,7 @@ def _attn_kernel(
     sm_scale,
     valid_k,
     has_vf=False,
+    has_shift=False,
 ):
     """Grid = (batch*heads, q_blocks, k_blocks); the k dimension is the
     innermost (sequential) axis, so only ONE (block_q, d) q tile and ONE
@@ -120,11 +121,18 @@ def _attn_kernel(
     masks keys at positions < vf — ragged LEFT padding (the LM's masked
     prefill), so ragged batches stay on the streaming path at long S
     instead of falling back to the materialized oracle. Key blocks
-    entirely inside the padding skip their compute."""
-    if has_vf:
-        vf_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
-    else:
-        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    entirely inside the padding skip their compute.
+
+    ``has_shift``: a traced SMEM scalar offsets the causal diagonal —
+    row i attends cols <= i - shift. Striped ring attention's per-step
+    blocks (``parallel/ring_attention.py`` layout="striped") are exactly
+    shift-0/shift-1 triangles, so every ring step runs this kernel's
+    causal skip path instead of an SPMD ``lax.cond`` that computes dead
+    blocks anyway."""
+    refs = list(refs)
+    vf_ref = refs.pop(0) if has_vf else None
+    shift_ref = refs.pop(0) if has_shift else None
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     j = pl.program_id(2)
     block_q = q_ref.shape[1]
     q_start = pl.program_id(1) * block_q
@@ -164,7 +172,8 @@ def _attn_kernel(
             rows = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            shift = shift_ref[0] if has_shift else 0
+            s = jnp.where(rows >= cols + shift, s, _NEG_INF)
         m = m_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
@@ -180,7 +189,10 @@ def _attn_kernel(
     # (the DMA still lands, the MXU stays idle).
     live = None
     if causal:
-        live = j * block_k <= q_start + block_q - 1
+        live = (
+            j * block_k + (shift_ref[0] if has_shift else 0)
+            <= q_start + block_q - 1
+        )
     if has_vf:
         past_pad = (j + 1) * block_k > vf_ref[0]
         live = past_pad if live is None else jnp.logical_and(live, past_pad)
@@ -326,6 +338,7 @@ def flash_attention_with_lse(
     causal: bool = False,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    causal_shift: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Streaming-kernel attention returning ``(out, lse)`` where ``lse``
     is the per-row logsumexp of the scaled scores, shape (b, h, s_q),
@@ -344,9 +357,16 @@ def flash_attention_with_lse(
     lse output would need its own streaming VJP); differentiating
     through it fails at the pallas_call. Use :func:`flash_attention` for
     training paths.
+
+    ``causal_shift`` (traced int scalar, requires ``causal=True``)
+    offsets the diagonal: row i attends cols <= i - shift. Rows with no
+    live key (i < shift) emit ``lse ~= -inf`` with UNSPECIFIED out
+    contents — the merge weight ``exp(lse - m)`` zeroes them, which is
+    the neutral element striped ring attention's shift-1 steps rely on.
     """
     return _flash_impl(
-        q, k, v, causal, block_q, block_k, with_lse=True
+        q, k, v, causal, block_q, block_k, with_lse=True,
+        causal_shift=causal_shift,
     )
 
 
@@ -406,13 +426,17 @@ def _flash_impl(
     block_k: int = DEFAULT_BLOCK_K,
     with_lse: bool = False,
     valid_from: jax.Array | None = None,
+    causal_shift: jax.Array | None = None,
 ):
+    if causal_shift is not None and not causal:
+        raise ValueError("causal_shift requires causal=True")
     if pltpu is None:  # pragma: no cover — jax builds without pallas-tpu
         return (
-            _reference_with_lse(q, k, v, causal, valid_from)
+            _reference_with_lse(q, k, v, causal, valid_from, causal_shift)
             if with_lse
             else attention_reference(
-                q, k, v, causal=causal, valid_from=valid_from
+                q, k, v, causal=causal, valid_from=valid_from,
+                causal_shift=causal_shift,
             )
         )
     b, h, s_q, d = q.shape
@@ -428,10 +452,11 @@ def _flash_impl(
     pad_k = (-s_k) % block_k
     if causal and pad_k and s_q != s_k:
         return (
-            _reference_with_lse(q, k, v, causal, valid_from)
+            _reference_with_lse(q, k, v, causal, valid_from, causal_shift)
             if with_lse
             else attention_reference(
-                q, k, v, causal=causal, valid_from=valid_from
+                q, k, v, causal=causal, valid_from=valid_from,
+                causal_shift=causal_shift,
             )
         )
     if pad_q or pad_k:
@@ -453,6 +478,7 @@ def _flash_impl(
         sm_scale=sm_scale,
         valid_k=s_k,
         has_vf=valid_from is not None,
+        has_shift=causal_shift is not None,
     )
     on_tpu = jax.default_backend() == "tpu"
     scratch = [
@@ -486,6 +512,17 @@ def _flash_impl(
         in_specs.append(
             pl.BlockSpec(
                 (1,), lambda bh, qi, kj: (bh,), memory_space=pltpu.SMEM
+            )
+        )
+    if causal_shift is not None:
+        # One global diagonal-offset scalar in SMEM (traced: striped
+        # ring varies it per step without recompiling).
+        operands.append(
+            jnp.reshape(jnp.asarray(causal_shift, jnp.int32), (1,))
+        )
+        in_specs.append(
+            pl.BlockSpec(
+                (1,), lambda bh, qi, kj: (0,), memory_space=pltpu.SMEM
             )
         )
     out, lse = pl.pallas_call(
@@ -527,12 +564,23 @@ def _flash_impl(
     return out, lse[:, 0, :].reshape(b, h, sp_q)[:, :, :s_q]
 
 
+def _causal_mask(s_q, s_k, causal_shift=None):
+    """THE oracle causal mask (row i attends cols <= i - shift) — shared
+    by both reference paths so the masking convention cannot fork."""
+    if causal_shift is not None:
+        return (
+            jnp.arange(s_q)[:, None] >= jnp.arange(s_k)[None, :] + causal_shift
+        )
+    return jnp.tril(jnp.ones((s_q, s_k), bool))
+
+
 def _reference_with_lse(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool,
     valid_from: jax.Array | None = None,
+    causal_shift: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Oracle-path ``(out, lse)`` computing the score matrix ONCE (the
     fallback exists because scores are expensive to materialize —
@@ -542,8 +590,7 @@ def _reference_with_lse(
         "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) / math.sqrt(d)
     if causal:
-        s_q, s_k = s.shape[-2:]
-        s = jnp.where(jnp.tril(jnp.ones((s_q, s_k), bool)), s, _NEG_INF)
+        s = jnp.where(_causal_mask(*s.shape[-2:], causal_shift), s, _NEG_INF)
     if valid_from is not None:
         cols = jnp.arange(s.shape[-1])
         live = cols[None, :] >= valid_from[:, None]
@@ -889,6 +936,7 @@ def attention_reference(
     v: jax.Array,
     causal: bool = False,
     valid_from: jax.Array | None = None,
+    causal_shift: jax.Array | None = None,
 ) -> jax.Array:
     """Pure-jnp oracle: softmax(QK^T / sqrt(d)) V with optional masks.
 
@@ -897,17 +945,17 @@ def attention_reference(
     the identity convention for the self-attention (s_q == s_k) shapes the
     framework uses. ``valid_from`` (b,) additionally masks each row's
     keys at positions < valid_from[row] — left-padding in ragged batches
-    (the LM's masked prefill). One oracle, one set of masking/precision
-    conventions.
+    (the LM's masked prefill). ``causal_shift`` offsets the causal
+    diagonal (row i attends j <= i - shift; see
+    :func:`flash_attention_with_lse`). One oracle, one set of
+    masking/precision conventions.
     """
     d = q.shape[-1]
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) / math.sqrt(d)
     if causal:
-        s_q, s_k = s.shape[-2:]
-        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
-        s = jnp.where(mask, s, _NEG_INF)
+        s = jnp.where(_causal_mask(*s.shape[-2:], causal_shift), s, _NEG_INF)
     if valid_from is not None:
         cols = jnp.arange(s.shape[-1])
         live = cols[None, :] >= valid_from[:, None]  # (b, s_k)

@@ -3,7 +3,11 @@ from adapt_tpu.parallel.pipeline_decode import (
     shard_for_pipeline,
 )
 from adapt_tpu.parallel.pipeline_spmd import spmd_pipeline, stack_stage_params
-from adapt_tpu.parallel.ring_attention import ring_attention
+from adapt_tpu.parallel.ring_attention import (
+    ring_attention,
+    stripe_sequence,
+    unstripe_sequence,
+)
 from adapt_tpu.parallel.ulysses import ulysses_attention
 from adapt_tpu.parallel.sharding import (
     batch_sharding,
@@ -18,6 +22,8 @@ __all__ = [
     "spmd_pipeline",
     "stack_stage_params",
     "ring_attention",
+    "stripe_sequence",
+    "unstripe_sequence",
     "ulysses_attention",
     "batch_sharding",
     "replicate",

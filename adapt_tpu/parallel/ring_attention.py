@@ -43,6 +43,37 @@ def _attention_block(q, k, v, mask, m, l, o):
     return m_new, l_new, o_new
 
 
+def stripe_sequence(x: jax.Array, num_ranks: int, axis: int = 2) -> jax.Array:
+    """Permute a sequence axis into the STRIPED ring layout: rank r's
+    shard holds tokens {r, r + P, r + 2P, ...} instead of a contiguous
+    block. ``stripe(x)[..., r*s_local + i, ...] = x[..., i*P + r, ...]``.
+    Apply to q/k/v before ``ring_attention(..., layout="striped")`` and
+    :func:`unstripe_sequence` to the output (a reshape-transpose; under
+    GSPMD it lowers to one all-to-all–class relayout at the boundary,
+    paid once per sequence, not per ring step)."""
+    s = x.shape[axis]
+    if s % num_ranks:
+        raise ValueError(f"sequence {s} not divisible by {num_ranks}")
+    parts = jnp.moveaxis(x, axis, 0).reshape(
+        s // num_ranks, num_ranks, *x.shape[:axis], *x.shape[axis + 1:]
+    )
+    return jnp.moveaxis(
+        jnp.swapaxes(parts, 0, 1).reshape(s, *x.shape[:axis],
+                                          *x.shape[axis + 1:]),
+        0, axis,
+    )
+
+
+def unstripe_sequence(x: jax.Array, num_ranks: int, axis: int = 2) -> jax.Array:
+    """Inverse of :func:`stripe_sequence` — which is striping by the
+    complementary factor (out[i*P + r] = x[r*(S/P) + i] both ways), so
+    one permutation body serves both and cannot desynchronize."""
+    s = x.shape[axis]
+    if s % num_ranks:
+        raise ValueError(f"sequence {s} not divisible by {num_ranks}")
+    return stripe_sequence(x, s // num_ranks, axis)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -51,6 +82,7 @@ def ring_attention(
     axis: str = "sp",
     causal: bool = False,
     block_impl: str = "jnp",
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Sequence-parallel attention over ``axis``.
 
@@ -79,6 +111,26 @@ def ring_attention(
       kernel dispatch uses), ``"jnp"`` otherwise. For inference
       pipelines that want the memory ceiling lifted without thinking;
       carries the same forward-only caveat whenever it picks flash.
+
+    ``layout`` is how global token positions map to shards:
+
+    - ``"contiguous"`` (default) — rank r holds tokens [r*S/P, (r+1)*S/P).
+      Under ``causal`` the ring is LOAD-IMBALANCED: rank 0's queries see
+      only their own block while rank P-1's see everything, and because
+      the ``ppermute`` rotation must run the same trip count on every
+      rank, the idle lower-triangle steps are latency floor, not saved
+      work (the flash path's ``lax.cond`` computes both branches under
+      SPMD).
+    - ``"striped"`` — rank r holds tokens {r, r+P, ...} (pre-permute
+      q/k/v with :func:`stripe_sequence`, un-permute the output with
+      :func:`unstripe_sequence`; the output of this function is in
+      striped order). Every causal ring step becomes a triangular block
+      with diagonal shift 0 (src <= rank) or 1 (src > rank) — uniformly
+      HALF the work on every rank at every step, with no cond at all:
+      the flash path passes the traced shift to the kernel's
+      ``causal_shift`` and rides its block-skip, the jnp path's mask
+      just uses striped positions. This is the classic striped-attention
+      balance fix; ~2x over contiguous causal at long S.
     """
     num_ranks = mesh.shape[axis]
     seq = q.shape[2]
@@ -90,6 +142,10 @@ def ring_attention(
     if block_impl not in ("auto", "jnp", "flash"):
         raise ValueError(
             f"block_impl={block_impl!r}: expected 'auto', 'jnp' or 'flash'"
+        )
+    if layout not in ("contiguous", "striped"):
+        raise ValueError(
+            f"layout={layout!r}: expected 'contiguous' or 'striped'"
         )
     if block_impl == "auto":
         from adapt_tpu.ops.attention import scores_over_budget
@@ -110,6 +166,7 @@ def ring_attention(
             num_ranks=num_ranks,
             s_local=s_local,
             ring=ring,
+            striped=layout == "striped",
         )
 
         @jax.custom_vjp
@@ -142,14 +199,23 @@ def ring_attention(
     def ringed(q_l, k_l, v_l):
         rank = lax.axis_index(axis)
         b, h, sq, d = q_l.shape
-        q_pos = rank * s_local + jnp.arange(s_local)
+        local = jnp.arange(s_local)
+        q_pos = (
+            local * num_ranks + rank
+            if layout == "striped"
+            else rank * s_local + local
+        )
 
         def step(carry, i):
             m, l, o, k_cur, v_cur = carry
             # After i hops of forward rotation, this rank holds the K/V
             # block that originated at rank - i (mod P).
             src = jnp.mod(rank - i, num_ranks)
-            kv_pos = src * s_local + jnp.arange(s_local)
+            kv_pos = (
+                local * num_ranks + src
+                if layout == "striped"
+                else src * s_local + local
+            )
             if causal:
                 mask = jnp.where(
                     q_pos[:, None] >= kv_pos[None, :], 0.0, _NEG_INF
@@ -181,7 +247,7 @@ def ring_attention(
 
 
 def _ring_attention_flash(
-    q, k, v, mesh, axis, causal, num_ranks, s_local, ring
+    q, k, v, mesh, axis, causal, num_ranks, s_local, ring, striped=False
 ):
     """Ring attention whose per-device block compute is the streaming
     Pallas kernel; per-step normalized results combine exactly via the
@@ -194,15 +260,21 @@ def _ring_attention_flash(
     mask tensor is ever built; the diagonal runs the kernel's own causal
     path and masked steps contribute ``lse = -inf`` to the merge.
 
-    The ``lax.cond`` on ``src < rank`` is *correctness* masking, not a
-    compute skip: under SPMD the predicate is device-varying, so XLA
-    lowers the cond to running both branches and selecting — every rank
-    pays the full kernel on its dead steps too. Shortening the loop
-    per-rank cannot fix this: the ``ppermute`` rotation must run the
-    same number of times on every rank or the collective deadlocks, so
-    the causal ring's lower triangle is latency floor, not saved work
-    (the classic fix — striped/zigzag block placement to balance live
-    work per rank — is a layout change, not a cond)."""
+    The CONTIGUOUS layout's ``lax.cond`` on ``src < rank`` is
+    *correctness* masking, not a compute skip: under SPMD the predicate
+    is device-varying, so XLA lowers the cond to running both branches
+    and selecting — every rank pays the full kernel on its dead steps
+    too. Shortening the loop per-rank cannot fix this: the ``ppermute``
+    rotation must run the same number of times on every rank or the
+    collective deadlocks, so the contiguous causal ring's lower triangle
+    is latency floor, not saved work.
+
+    ``striped=True`` IS the classic layout fix: with tokens striped
+    round-robin (see :func:`stripe_sequence`), every (rank, step) causal
+    block is a triangle with diagonal shift ``src > rank`` — no cond, no
+    dead blocks; each step passes the traced shift to the kernel's
+    ``causal_shift`` and its block-level skip does ~half the work,
+    uniformly on every rank."""
     from adapt_tpu.ops.attention import flash_attention_with_lse
 
     spec = P(None, None, axis, None)
@@ -240,7 +312,16 @@ def _ring_attention_flash(
                     jnp.full(lse.shape, _NEG_INF, jnp.float32),
                 )
 
-            if causal:
+            if causal and striped:
+                # Balanced path: every step is a shift-0/1 triangle —
+                # the kernel's own causal block-skip does ~half the
+                # work on every rank, no cond, no dead blocks.
+                o_j, lse_j = flash_attention_with_lse(
+                    q_l, k_cur, v_cur, causal=True,
+                    causal_shift=(src > rank).astype(jnp.int32),
+                )
+                o_j = o_j.astype(jnp.float32)
+            elif causal:
                 o_j, lse_j = lax.cond(src < rank, live, dead, None)
             else:
                 o_j, lse_j = live(None)

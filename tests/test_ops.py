@@ -361,3 +361,56 @@ def test_ragged_prefill_routes_through_measured_dispatch(monkeypatch):
     )
     # One vf-masked kernel call per decoder block, no dense/oracle calls.
     assert calls == [True] * lm.depth, calls
+
+
+def test_flash_with_lse_causal_shift_matches_reference():
+    """causal_shift offsets the kernel's causal diagonal (row i attends
+    cols <= i - shift); out and lse must match the masked oracle on every
+    row that has at least one live key (rows < shift have unspecified
+    out and lse ~ -inf — the merge-neutral element)."""
+    from adapt_tpu.ops.attention import (
+        _reference_with_lse,
+        flash_attention_with_lse,
+    )
+
+    b, h, s, d = 1, 2, 64, 16
+    q = jax.random.normal(jax.random.PRNGKey(50), (b, h, s, d))
+    k = jax.random.normal(jax.random.PRNGKey(51), (b, h, s, d))
+    v = jax.random.normal(jax.random.PRNGKey(52), (b, h, s, d))
+    for shift in (0, 1):
+        out, lse = flash_attention_with_lse(
+            q, k, v, causal=True,
+            causal_shift=jnp.asarray(shift, jnp.int32),
+        )
+        ref_out, ref_lse = _reference_with_lse(
+            q, k, v, True, causal_shift=jnp.asarray(shift, jnp.int32)
+        )
+        np.testing.assert_allclose(
+            np.asarray(out)[:, :, shift:],
+            np.asarray(ref_out)[:, :, shift:],
+            rtol=2e-4, atol=2e-4, err_msg=f"shift={shift}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(lse)[:, :, shift:],
+            np.asarray(ref_lse)[:, :, shift:],
+            rtol=2e-4, atol=2e-4, err_msg=f"shift={shift}",
+        )
+        if shift:
+            assert np.asarray(lse)[:, :, 0].max() < -1e29
+
+    # shift=0 must equal the plain causal path bit-for-bit semantics.
+    out_s0, lse_s0 = flash_attention_with_lse(
+        q, k, v, causal=True, causal_shift=jnp.asarray(0, jnp.int32)
+    )
+    out_plain, lse_plain = flash_attention_with_lse(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out_s0), np.asarray(out_plain), rtol=1e-6, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(lse_s0), np.asarray(lse_plain), rtol=1e-6, atol=1e-6
+    )
+
+    with pytest.raises(ValueError, match="causal_shift"):
+        flash_attention_with_lse(
+            q, k, v, causal=False, causal_shift=jnp.asarray(1, jnp.int32)
+        )
