@@ -34,6 +34,8 @@ from jax import lax
 
 from adapt_tpu.graph.ir import INPUT, LayerGraph
 from adapt_tpu.ops.attention import flash_attention
+from adapt_tpu.ops.decode_attention import decode_attention
+from adapt_tpu.ops.quantize import quantize_kv_vectors
 
 _NEG_INF = -1e30
 
@@ -150,22 +152,10 @@ class CausalSelfAttention(nn.Module):
         )
         return self.out(jnp.swapaxes(o, 1, 2).reshape(b, s, d))
 
-    @staticmethod
-    def _quantize_kv(t):
-        """Per-(batch, head, position) absmax int8 over head_dim — the
-        standard KV-cache quantization granularity (one scale per key
-        vector). Returns (int8 values, f32 scales with keepdims)."""
-        scale = (
-            jnp.max(jnp.abs(t.astype(jnp.float32)), axis=-1, keepdims=True)
-            / 127.0
-        )
-        scale = jnp.maximum(scale, 1e-8)
-        vals = (
-            jnp.round(t.astype(jnp.float32) / scale)
-            .clip(-127, 127)
-            .astype(jnp.int8)
-        )
-        return vals, scale
+    # One scale per cached key/value vector — the shared scheme in
+    # ops.quantize (the kernel tests and on-chip smoke quantize with the
+    # same function, so the definition cannot fork).
+    _quantize_kv = staticmethod(quantize_kv_vectors)
 
     def prefill(self, x, max_len: int, valid_from=None, quantize_cache=False):
         """Full causal attention over the prompt, returning output plus
@@ -221,7 +211,8 @@ class CausalSelfAttention(nn.Module):
         return lax.dynamic_update_slice(cache, new, (0, 0, index, 0))
 
     def decode_step(
-        self, x_t, cache_k, cache_v, index, valid_from=None, quantized=False
+        self, x_t, cache_k, cache_v, index, valid_from=None, quantized=False,
+        attn_impl=None,
     ):
         """One token: write its K/V at ``index``, attend its q over the
         cache. ``index`` is traced — the same compiled step serves every
@@ -229,14 +220,16 @@ class CausalSelfAttention(nn.Module):
         (each row at its own position; see ``_cache_write``).
         ``valid_from`` (b,) masks a ragged batch's left padding out of
         the cache window. ``quantized`` caches are ``(int8 values, f32
-        scales)`` pairs (see ``prefill``); the dequantize multiplies
-        fuse into the attention matmuls."""
+        scales)`` pairs (see ``prefill``). The attention itself is
+        :func:`adapt_tpu.ops.decode_attention.decode_attention` —
+        ``attn_impl`` (None = measured auto, ``"xla"``, ``"pallas"``)
+        picks between the einsum schedule and the streaming Pallas
+        kernel that dequantizes int8 caches in VMEM."""
         b = x_t.shape[0]
         q, k, v = self._project(x_t)  # q (b, h, 1, hd); k/v (b, kv_h, 1, hd)
-        # GQA: fold query-head groups into query rows so the einsums
-        # below run unchanged against the small (b, kv_h, L, hd) cache.
+        # GQA: fold query-head groups into query rows so the attention
+        # runs unchanged against the small (b, kv_h, L, hd) cache.
         q = self._group_q(q)  # (b, kv_h, g, hd)
-        sm = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
         if quantized:
             (kvl, ksc), (vvl, vsc) = cache_k, cache_v
             nk, nks = self._quantize_kv(k)
@@ -246,47 +239,12 @@ class CausalSelfAttention(nn.Module):
             vvl = self._cache_write(vvl, nv, index)
             vsc = self._cache_write(vsc, nvs, index)
             cache_k, cache_v = (kvl, ksc), (vvl, vsc)
-            # Per-vector scales factor exactly OUT of the dots: apply
-            # them to the small (b, h, 1, L) score/probability rows, so
-            # the only op on the big cache operand is the int8->f32
-            # convert (the most reliably dot-fused elementwise form) —
-            # never a materialized dequantized cache.
-            s = jnp.einsum(
-                "bhqd,bhkd->bhqk",
-                q.astype(jnp.float32),
-                kvl.astype(jnp.float32),
-            ) * jnp.swapaxes(ksc, 2, 3) * sm  # (b, h, 1, L)
-            n_pos = kvl.shape[2]
         else:
             cache_k = self._cache_write(cache_k, k, index)
             cache_v = self._cache_write(cache_v, v, index)
-            s = (
-                jnp.einsum(
-                    "bhqd,bhkd->bhqk",
-                    q.astype(jnp.float32),
-                    cache_k.astype(jnp.float32),
-                )
-                * sm
-            )  # (b, h, 1, max_len)
-            n_pos = cache_k.shape[2]
-        positions = jnp.arange(n_pos)
-        live = positions[None, :] <= (
-            index[:, None] if jnp.ndim(index) else index
-        )
-        if valid_from is not None:
-            live = live & (positions[None, :] >= valid_from[:, None])
-        s = jnp.where(live[:, None, None, :], s, _NEG_INF)
-        p = jax.nn.softmax(s, axis=-1)
-        if quantized:
-            o = jnp.einsum(
-                "bhqk,bhkd->bhqd",
-                p * jnp.swapaxes(vsc, 2, 3),
-                vvl.astype(jnp.float32),
-            ).astype(x_t.dtype)
-        else:
-            o = jnp.einsum(
-                "bhqk,bhkd->bhqd", p, cache_v.astype(jnp.float32)
-            ).astype(x_t.dtype)
+        o = decode_attention(
+            q, cache_k, cache_v, index, valid_from, prefer=attn_impl
+        ).astype(x_t.dtype)
         o = self._ungroup_o(o, 1)  # (b, h, 1, hd)
         o = jnp.swapaxes(o, 1, 2).reshape(b, 1, self.dim)
         return self.out(o), cache_k, cache_v
@@ -374,10 +332,12 @@ class DecoderBlock(nn.Module):
         return x + self._mlp(self.ln2(x)), ck, cv
 
     def decode_step(
-        self, x_t, cache_k, cache_v, index, valid_from=None, quantized=False
+        self, x_t, cache_k, cache_v, index, valid_from=None, quantized=False,
+        attn_impl=None,
     ):
         a, ck, cv = self.attn.decode_step(
-            self.ln1(x_t), cache_k, cache_v, index, valid_from, quantized
+            self.ln1(x_t), cache_k, cache_v, index, valid_from, quantized,
+            attn_impl,
         )
         x_t = x_t + a
         return x_t + self._mlp(self.ln2(x_t)), ck, cv
@@ -648,6 +608,7 @@ def generate(
     rng: jax.Array | None = None,
     prompt_lengths: jax.Array | None = None,
     kv_cache_dtype: str = "native",
+    decode_attn: str | None = None,
 ) -> jax.Array:
     """Generation as one compiled program: prefill over the prompt + a
     ``lax.scan`` of single-token cached decode steps.
@@ -682,11 +643,19 @@ def generate(
     top_k, and the sample/top_p/eos on-off booleans); temperature,
     top_p, and eos_id are traced operands, so a server sweeping them
     per request reuses one compiled program.
+
+    ``decode_attn`` picks the per-step attention implementation (None =
+    measured auto, ``"xla"``, ``"pallas"`` — see
+    :mod:`adapt_tpu.ops.decode_attention`).
     """
     lengths, rng, do_sample = validate_generate_args(
         lm, prompt, steps, temperature, top_k, rng, prompt_lengths,
         kv_cache_dtype, top_p=top_p,
     )
+    if decode_attn not in (None, "xla", "pallas"):
+        raise ValueError(
+            f"decode_attn={decode_attn!r}: expected None, 'xla' or 'pallas'"
+        )
     return _generate_impl(
         lm,
         variables,
@@ -705,6 +674,7 @@ def generate(
         use_eos=eos_id is not None,
         ragged=prompt_lengths is not None,
         kv_quant=kv_cache_dtype == "int8",
+        decode_attn=decode_attn,
     )
 
 
@@ -712,7 +682,7 @@ def generate(
     jax.jit,
     static_argnames=(
         "lm", "steps", "do_sample", "top_k", "use_top_p", "use_eos",
-        "ragged", "kv_quant",
+        "ragged", "kv_quant", "decode_attn",
     ),
 )
 def _generate_impl(
@@ -732,6 +702,7 @@ def _generate_impl(
     use_eos: bool,
     ragged: bool,
     kv_quant: bool,
+    decode_attn: str | None = None,
 ) -> jax.Array:
     g = lm.graph
     b, s0 = prompt.shape
@@ -804,6 +775,7 @@ def _generate_impl(
                 index,
                 valid_from,
                 kv_quant,
+                decode_attn,
                 method="decode_step",
             )
             new_caches.append((ck, cv))

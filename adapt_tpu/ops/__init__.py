@@ -6,10 +6,16 @@ from adapt_tpu.ops.quantize import (
     quantize_reference,
 )
 from adapt_tpu.ops.attention import attention_reference, flash_attention
+from adapt_tpu.ops.decode_attention import (
+    decode_attention,
+    decode_attention_reference,
+)
 
 __all__ = [
     "QuantizedTensor",
     "attention_reference",
+    "decode_attention",
+    "decode_attention_reference",
     "dequantize",
     "dequantize_reference",
     "flash_attention",

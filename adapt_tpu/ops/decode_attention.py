@@ -1,0 +1,359 @@
+"""Decode-time (single-token) cached attention as a Pallas TPU kernel.
+
+The serving hot path: at every decode step each query token attends over
+the whole KV cache — a (b, kv_h, g, L) score row, no S x S anything —
+and the step is HBM-bandwidth-bound (the cache is read end to end per
+token). The XLA path (``decode_attention_reference``, the exact einsum
+schedule ``models/transformer_lm.CausalSelfAttention.decode_step`` has
+always run) handles the native-dtype cache well, but the r04 hardware
+A/B (``benchmarks/results/r04/lm_decode_long_{native,int8}.json``)
+showed the int8 cache ~12% SLOWER than bf16 despite carrying ~1.9x
+fewer bytes: XLA does not reliably keep the per-step dequantize fused
+to the HBM stream. This kernel exists to close that gap the TPU-native
+way — the int8 values stream from HBM and dequantize in VMEM, so the
+bytes that cross the HBM bus are the int8 bytes.
+
+Layout (one kernel for native and int8 caches):
+
+- grid = (batch * kv_heads, L / block_k); the cache-position axis is the
+  innermost (sequential) dimension, online-softmax state (running max,
+  denom, accumulator) persists across it in VMEM scratch — the same
+  discipline as ``ops/attention``'s streaming kernel, with q a single
+  (g, head_dim) tile (GQA query groups folded into query ROWS, matching
+  ``CausalSelfAttention._group_q``; g is zero-padded to a sublane
+  multiple).
+- int8 scales (one f32 per cached key/value vector, the product
+  quantization granularity) ride as a (b*kv_h, L/128, 128) chunked view
+  — the same bytes as the (b, kv_h, L, 1) product layout, 1/16th of the
+  int8 payload, never 8-row-broadcast — and are applied to the score /
+  probability COLUMNS, so the only op on the big cache operand is the
+  int8 contribution to the dot.
+- the live window (positions <= index, >= valid_from for ragged rows)
+  is masked via SMEM scalars; blocks entirely outside the window skip
+  their compute (``pl.when``), which matters early in a long-max_len
+  decode where most of the cache is still dead.
+
+Dispatch: ``prefer=None`` ("auto") consults ``decode_kernel_wins`` —
+measured on hardware like ``ops/attention``'s budget (artifact:
+``benchmarks/results/r04/lm_decode_*``; see the function docstring for
+the current rule). ``prefer="pallas"``/``"xla"`` force a path (tests,
+the A/B driver). Off-TPU the kernel runs through the Pallas
+interpreter, so the virtual-mesh tests exercise the same code path.
+
+No reference analog (the reference is CNN-only, SURVEY.md §2.2) — this
+is the framework's own serving frontier, the decode-side counterpart of
+``ops/attention``'s long-context prefill kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover — jax builds without pallas-tpu
+    pltpu = None
+    _VMEM = None
+
+_NEG_INF = -1e30
+
+#: Cache-position block per grid step. 1024 = 8 sublanes x 128 lanes of
+#: the chunked scale view, the smallest block whose scale tile satisfies
+#: TPU (8, 128) tiling without broadcast padding — so the kernel requires
+#: max_len % 1024 == 0 (every serving config in the repo uses powers of
+#: two >= 1024 when long context is the point; shorter caches stay on
+#: XLA, which wins there anyway).
+DECODE_BLOCK_K = 1024
+
+
+def decode_kernel_wins(cache_len: int, quantized: bool) -> bool:
+    """THE auto-dispatch predicate for decode attention, in one place
+    like ``ops/attention.scores_over_budget``. Current rule: XLA
+    everywhere — the kernel ships behind ``prefer="pallas"`` until its
+    hardware A/B (``benchmarks/lm_decode.py --decode-attn pallas``)
+    lands; retune this predicate from that artifact, not from
+    intuition."""
+    del cache_len, quantized
+    return False
+
+
+def _supported(cache_len: int, block_k: int) -> bool:
+    return pltpu is not None and cache_len % block_k == 0
+
+
+def _decode_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    idx_ref,
+    *refs,
+    block_k,
+    num_kv,
+    sm_scale,
+    quantized,
+    has_vf,
+):
+    """One (batch, kv_head) row: stream cache blocks innermost, online
+    softmax in scratch. ``q_ref`` (1, gq, hd) — gq = GQA group rows,
+    sublane-padded; ``k_ref``/``v_ref`` (1, block_k, hd) int8 or native;
+    scale tiles (1, 8, 128) f32 chunked views covering this block's
+    positions row-major; ``idx_ref``/``vf_ref`` (1,) SMEM scalars."""
+    refs = list(refs)
+    ksc_ref = refs.pop(0) if quantized else None
+    vsc_ref = refs.pop(0) if quantized else None
+    vf_ref = refs.pop(0) if has_vf else None
+    o_ref, m_scr, l_scr, acc_scr = refs
+    j = pl.program_id(1)
+    gq = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32)  # (gq, hd)
+        k = k_ref[0].astype(jnp.float32)  # (block_k, hd)
+        v = v_ref[0].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * sm_scale
+        )  # (gq, block_k)
+        if quantized:
+            # (8, 128) chunk -> one scale per column of this block; the
+            # per-vector scale factors exactly OUT of the dot, applied
+            # to the small score row instead of the big cache operand.
+            ksc = ksc_ref[0].reshape(1, block_k)
+            s = s * ksc
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (gq, block_k), 1
+        )
+        live = cols <= idx_ref[0]
+        if has_vf:
+            live = jnp.logical_and(live, cols >= vf_ref[0])
+        s = jnp.where(live, s, _NEG_INF)
+        m = m_scr[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = p * vsc_ref[0].reshape(1, block_k) if quantized else p
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            pv, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    # Blocks entirely past the write index (the still-dead cache tail)
+    # or entirely inside ragged left padding contribute nothing.
+    live_block = j * block_k <= idx_ref[0]
+    if has_vf:
+        live_block = jnp.logical_and(
+            live_block, (j + 1) * block_k > vf_ref[0]
+        )
+    pl.when(live_block)(_step)
+
+    @pl.when(j == num_kv - 1)
+    def _emit():
+        o_ref[0] = (
+            acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_k",))
+def _decode_impl(q, k_vals, v_vals, k_scales, v_scales, index, valid_from,
+                 block_k):
+    b, kvh, g, hd = q.shape
+    cache_len = k_vals.shape[2]
+    num_kv = cache_len // block_k
+    quantized = k_scales is not None
+    has_vf = valid_from is not None
+    pad_g = (-g) % 8  # sublane-pad the query rows
+    if pad_g:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_g), (0, 0)))
+    gq = g + pad_g
+
+    qf = q.reshape(b * kvh, gq, hd)
+    kf = k_vals.reshape(b * kvh, cache_len, hd)
+    vf = v_vals.reshape(b * kvh, cache_len, hd)
+    idx = jnp.repeat(
+        jnp.broadcast_to(jnp.asarray(index, jnp.int32).reshape(-1), (b,)),
+        kvh,
+    )
+    sm_scale = 1.0 / (hd ** 0.5)
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, gq, hd), lambda bh, j: (bh, 0, 0), memory_space=_VMEM
+        ),
+        pl.BlockSpec(
+            (1, block_k, hd), lambda bh, j: (bh, j, 0), memory_space=_VMEM
+        ),
+        pl.BlockSpec(
+            (1, block_k, hd), lambda bh, j: (bh, j, 0), memory_space=_VMEM
+        ),
+        pl.BlockSpec((1,), lambda bh, j: (bh,), memory_space=pltpu.SMEM),
+    ]
+    operands = [qf, kf, vf, idx]
+    if quantized:
+        # (b, kvh, L, 1) f32 -> (b*kvh, L/128, 128) chunked view: the
+        # same bytes row-major (position = row*128 + lane), one (1, 8,
+        # 128) tile per 1024-position block — no broadcast inflation.
+        chunk = lambda s: s.reshape(b * kvh, cache_len // 128, 128)
+        rows_per_block = block_k // 128
+        for s in (k_scales, v_scales):
+            operands.append(chunk(s.astype(jnp.float32)))
+            in_specs.append(
+                pl.BlockSpec(
+                    (1, rows_per_block, 128),
+                    lambda bh, j: (bh, j, 0),
+                    memory_space=_VMEM,
+                )
+            )
+    if has_vf:
+        operands.append(jnp.repeat(jnp.asarray(valid_from, jnp.int32), kvh))
+        in_specs.append(
+            pl.BlockSpec((1,), lambda bh, j: (bh,), memory_space=pltpu.SMEM)
+        )
+
+    on_tpu = jax.default_backend() == "tpu"
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel,
+            block_k=block_k,
+            num_kv=num_kv,
+            sm_scale=sm_scale,
+            quantized=quantized,
+            has_vf=has_vf,
+        ),
+        grid=(b * kvh, num_kv),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, gq, hd), lambda bh, j: (bh, 0, 0), memory_space=_VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * kvh, gq, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((gq, 1), jnp.float32),
+            pltpu.VMEM((gq, 1), jnp.float32),
+            pltpu.VMEM((gq, hd), jnp.float32),
+        ],
+        compiler_params=(
+            pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")
+            )
+            if on_tpu
+            else None
+        ),
+        interpret=not on_tpu,
+    )(*operands)
+    return out.reshape(b, kvh, gq, hd)[:, :, :g, :]
+
+
+def decode_attention_reference(q, cache_k, cache_v, index, valid_from=None):
+    """The XLA oracle — the exact einsum schedule ``decode_step`` has
+    always run (f32 scores, position mask over the full buffer, scales
+    applied to the score/probability rows for int8 caches), lifted here
+    so both paths share one definition.
+
+    q: (b, kv_h, g, hd) group-folded queries; caches (b, kv_h, L, hd)
+    arrays or ``(int8 values, f32 scales)`` pairs; ``index`` scalar or
+    (b,); returns (b, kv_h, g, hd) in q's dtype."""
+    quantized = isinstance(cache_k, tuple)
+    sm = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    if quantized:
+        (kvl, ksc), (vvl, vsc) = cache_k, cache_v
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk",
+            q.astype(jnp.float32),
+            kvl.astype(jnp.float32),
+        ) * jnp.swapaxes(ksc, 2, 3) * sm
+        n_pos = kvl.shape[2]
+    else:
+        s = (
+            jnp.einsum(
+                "bhqd,bhkd->bhqk",
+                q.astype(jnp.float32),
+                cache_k.astype(jnp.float32),
+            )
+            * sm
+        )
+        n_pos = cache_k.shape[2]
+    positions = jnp.arange(n_pos)
+    live = positions[None, :] <= (
+        index[:, None] if jnp.ndim(index) else index
+    )
+    if valid_from is not None:
+        live = live & (positions[None, :] >= valid_from[:, None])
+    s = jnp.where(live[:, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if quantized:
+        o = jnp.einsum(
+            "bhqk,bhkd->bhqd",
+            p * jnp.swapaxes(vsc, 2, 3),
+            vvl.astype(jnp.float32),
+        )
+    else:
+        o = jnp.einsum(
+            "bhqk,bhkd->bhqd", p, cache_v.astype(jnp.float32)
+        )
+    return o.astype(q.dtype)
+
+
+def decode_attention(
+    q: jax.Array,
+    cache_k,
+    cache_v,
+    index,
+    valid_from=None,
+    prefer: str | None = None,
+    block_k: int = DECODE_BLOCK_K,
+) -> jax.Array:
+    """Cached decode attention over the live window ``[valid_from,
+    index]`` of a KV cache.
+
+    q: (b, kv_h, g, hd) — GQA groups already folded into query rows
+    (``CausalSelfAttention._group_q``; g = heads//kv_h x tokens).
+    Caches: (b, kv_h, L, hd) arrays, or ``(int8 values, f32 scales)``
+    pairs with one scale per cached vector. ``index`` (scalar or (b,))
+    is the newest live position — the caller has already written this
+    step's K/V there. Returns (b, kv_h, g, hd).
+
+    ``prefer``: None = auto (``decode_kernel_wins``, the measured rule),
+    ``"xla"`` = the einsum oracle, ``"pallas"`` = the streaming kernel
+    (falls back to the oracle off-pallas or when L is not a multiple of
+    ``block_k`` — the kernel's scale-tile layout needs 1024-divisible
+    caches)."""
+    quantized = isinstance(cache_k, tuple)
+    cache_len = (cache_k[0] if quantized else cache_k).shape[2]
+    if prefer is None:
+        prefer = (
+            "pallas" if decode_kernel_wins(cache_len, quantized) else "xla"
+        )
+    elif prefer not in ("pallas", "xla"):
+        raise ValueError(
+            f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
+        )
+    if prefer == "pallas" and _supported(cache_len, block_k):
+        if quantized:
+            (kvl, ksc), (vvl, vsc) = cache_k, cache_v
+            return _decode_impl(
+                q, kvl, vvl, ksc, vsc, index, valid_from, block_k
+            )
+        return _decode_impl(
+            q, cache_k, cache_v, None, None, index, valid_from, block_k
+        )
+    return decode_attention_reference(
+        q, cache_k, cache_v, index, valid_from
+    )

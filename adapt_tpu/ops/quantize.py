@@ -146,6 +146,25 @@ def dequantize(qt: QuantizedTensor) -> jax.Array:
     return out.reshape(-1)[:size].reshape(qt.shape).astype(qt.dtype)
 
 
+def quantize_kv_vectors(t: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-vector absmax int8 over the trailing (head_dim) axis — THE
+    KV-cache quantization scheme (one f32 scale per cached key/value
+    vector), shared by ``CausalSelfAttention``, the decode-attention
+    kernel tests and the on-chip smoke so the definition cannot fork.
+    Returns ``(int8 values, f32 scales with keepdims)``."""
+    scale = (
+        jnp.max(jnp.abs(t.astype(jnp.float32)), axis=-1, keepdims=True)
+        / 127.0
+    )
+    scale = jnp.maximum(scale, 1e-8)
+    vals = (
+        jnp.round(t.astype(jnp.float32) / scale)
+        .clip(-127, 127)
+        .astype(jnp.int8)
+    )
+    return vals, scale
+
+
 # -- pure-jnp oracles (unit-test ground truth) -------------------------------
 
 

@@ -1,0 +1,108 @@
+"""Compiled on-chip smoke of the decode-attention Pallas kernel.
+
+The decode kernel's one Mosaic-lowering risk is the scale-tile reshape
+((8, 128) chunk -> (1, 1024) score-column row). This driver runs the
+kernel COMPILED on the real chip across its shape classes (native/int8,
+MHA/GQA rows, scalar/per-row index, ragged) and checks each against the
+einsum oracle — the same checks `tests/test_decode_attention.py` runs in
+interpreter mode. One JSON line; nonzero exit if any class fails to
+compile or mismatches.
+
+Usage: ``python benchmarks/decode_attn_smoke.py``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import run_child_json  # noqa: E402
+
+
+def _child() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from adapt_tpu.ops.decode_attention import (
+        decode_attention,
+        decode_attention_reference,
+    )
+    from adapt_tpu.ops.quantize import quantize_kv_vectors
+
+    rng = jax.random.PRNGKey(0)
+    cases = []
+
+    def check(name, q, ck, cv, index, valid_from=None, tol=2e-3):
+        ref = np.asarray(
+            decode_attention_reference(q, ck, cv, index, valid_from)
+        )
+        out = np.asarray(
+            decode_attention(q, ck, cv, index, valid_from, prefer="pallas")
+        )
+        err = float(np.max(np.abs(out - ref)))
+        cases.append({"case": name, "max_err": err, "ok": err < tol})
+
+    b, kvh, hd = 4, 12, 64
+    for name, length, g, quantized, per_row, ragged in [
+        ("native_mha_2k", 2048, 1, False, False, False),
+        ("int8_mha_2k", 2048, 1, True, False, False),
+        ("int8_gqa4_4k", 4096, 4, True, False, False),
+        ("native_per_row_idx", 2048, 1, False, True, False),
+        ("int8_ragged", 2048, 1, True, False, True),
+    ]:
+        kq, kk, kv_ = jax.random.split(jax.random.fold_in(rng, length + g), 3)
+        q = jax.random.normal(kq, (b, kvh, g, hd), jnp.float32)
+        k = jax.random.normal(kk, (b, kvh, length, hd), jnp.float32)
+        v = jax.random.normal(kv_, (b, kvh, length, hd), jnp.float32)
+        ck, cv = (
+            (quantize_kv_vectors(k), quantize_kv_vectors(v))
+            if quantized
+            else (k, v)
+        )
+        index = (
+            jnp.asarray([7, length - 1, length // 2, 1023], jnp.int32)
+            if per_row
+            else jnp.asarray(length - 1, jnp.int32)
+        )
+        vf = (
+            jnp.asarray([0, 900, 5, 300], jnp.int32) if ragged else None
+        )
+        check(name, q, ck, cv, index, vf)
+
+    ok = all(c["ok"] for c in cases)
+    print(
+        json.dumps(
+            {
+                "metric": "decode_attn_smoke_cases_ok",
+                "value": sum(c["ok"] for c in cases),
+                "unit": "cases",
+                "vs_baseline": 1.0 if ok else 0.0,
+                "platform": jax.devices()[0].platform,
+                "device": str(jax.devices()[0]),
+                "cases": cases,
+            }
+        ),
+        flush=True,
+    )
+    if not ok:
+        raise SystemExit(1)
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        _child()
+        return 0
+    return run_child_json(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        metric="decode_attn_smoke_cases_ok",
+        unit="cases",
+        timeout_s=800,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

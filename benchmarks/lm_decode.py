@@ -26,8 +26,14 @@ traffic, or the weights term hides the difference. The MBU denominator
 counts weight bytes + per-step mean cache bytes actually resident, so
 vs_baseline stays honest across cache dtypes.
 
+``--decode-attn pallas`` swaps the per-step attention for the streaming
+Pallas decode kernel (``ops/decode_attention``), which dequantizes int8
+caches in VMEM — the A/B that decides ``decode_kernel_wins``'s measured
+dispatch rule.
+
 Usage: ``python benchmarks/lm_decode.py [--batch 8] [--steps 128]
-[--prompt 64] [--maxlen 256] [--kv native|int8]``
+[--prompt 64] [--maxlen 256] [--kv native|int8]
+[--decode-attn auto|xla|pallas]``
 """
 
 from __future__ import annotations
@@ -52,7 +58,7 @@ TPU_V5E_HBM_BYTES_PER_S = 819e9
 
 def _child(
     batch: int, steps: int, trials: int, prompt_len: int, max_len: int,
-    kv: str,
+    kv: str, decode_attn: str,
 ) -> None:
     import jax
     import jax.numpy as jnp
@@ -87,9 +93,21 @@ def _child(
         return statistics.median(times)
 
     kv_dtype = "int8" if kv == "int8" else "native"
+    attn = None if decode_attn == "auto" else decode_attn
+    if attn == "pallas" and max_len % 1024:
+        # decode_attention silently serves the oracle when the cache
+        # length is not kernel-eligible — an A/B row labeled
+        # `_attn_pallas` that actually measured XLA would corrupt the
+        # measured dispatch rule. Refuse instead.
+        raise SystemExit(
+            f"--decode-attn pallas needs --maxlen % 1024 == 0 "
+            f"(got {max_len}): the kernel would fall back to XLA and "
+            "the artifact label would lie"
+        )
     cached_s = timed(
         lambda p: generate(
-            lm, variables, p, steps, kv_cache_dtype=kv_dtype
+            lm, variables, p, steps, kv_cache_dtype=kv_dtype,
+            decode_attn=attn,
         ),
         prompt,
     )
@@ -113,6 +131,8 @@ def _child(
     mbu = (cached_tok_s / batch) / ceiling_steps_s
 
     suffix = "_kv_int8" if kv_dtype == "int8" else ""
+    if decode_attn != "auto":
+        suffix += f"_attn_{decode_attn}"
     print(
         json.dumps(
             {
@@ -144,14 +164,20 @@ def main() -> int:
     prompt_len = int_flag(sys.argv, "--prompt", 64)
     max_len = int_flag(sys.argv, "--maxlen", 256)
     kv = str_flag(sys.argv, "--kv", "native", choices=("native", "int8"))
+    decode_attn = str_flag(
+        sys.argv, "--decode-attn", "auto", choices=("auto", "xla", "pallas")
+    )
     if "--child" in sys.argv:
-        _child(batch, steps, trials, prompt_len, max_len, kv)
+        _child(batch, steps, trials, prompt_len, max_len, kv, decode_attn)
         return 0
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            "--batch", str(batch), "--steps", str(steps),
            "--trials", str(trials), "--prompt", str(prompt_len),
-           "--maxlen", str(max_len), "--kv", kv]
+           "--maxlen", str(max_len), "--kv", kv,
+           "--decode-attn", decode_attn]
     suffix = "_kv_int8" if kv == "int8" else ""
+    if decode_attn != "auto":
+        suffix += f"_attn_{decode_attn}"
     return run_child_json(
         cmd,
         metric=f"lm_decode_bs{batch}_tokens_per_sec{suffix}",

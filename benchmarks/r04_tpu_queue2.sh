@@ -1,0 +1,33 @@
+#!/bin/bash
+# Round-4 TPU queue, run 2: the tail that run 1's ViT bs>=64 relay
+# hangs ate (queue items 4b-6), plus a solo headline recapture.
+# Serial by design: NEVER two JAX processes through the relay at once.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/results/r04
+mkdir -p "$OUT"
+log() { echo "=== $(date +%H:%M:%S) $*"; }
+
+log "0. probe"
+timeout 90 python -c "import jax; print(jax.devices())" || {
+  echo "relay still down; aborting queue"; exit 1; }
+
+log "4b. ViT-B/16 bs 64/128 (timed out through the relay in run 1)"
+for BS in 64 128; do
+  timeout 1200 python benchmarks/tpu_models.py --model vit_b16 \
+    --batch "$BS" | tail -1 | tee "$OUT/vit_b16_bs${BS}.json"
+done
+
+log "5. continuous batching at serving scale (GPT-2 width)"
+timeout 2700 python benchmarks/continuous_serve.py --slots 8 \
+  --requests 32 --chunk 16 | tail -1
+# (driver writes results/r04/continuous_serve.json itself)
+
+log "6. speculative decoding mechanism bounds (GPT-2 width)"
+timeout 2700 python benchmarks/speculative_decode.py --draft self --k 4 \
+  | tail -1
+timeout 2700 python benchmarks/speculative_decode.py --draft tiny --k 4 \
+  | tail -1
+# (driver appends to results/r04/speculative_decode.json)
+
+log "queue2 done"

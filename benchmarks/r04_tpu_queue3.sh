@@ -1,0 +1,41 @@
+#!/bin/bash
+# Round-4 TPU queue, run 3: decode-attention kernel A/B.
+# 0) compiled smoke of the kernel on the chip (the scale-tile reshape is
+#    the one Mosaic-lowering risk — fail fast, cheaply);
+# 1) long-context A/B rows: native + int8 caches through the kernel, to
+#    stand against run 1's XLA rows (lm_decode_long_{native,int8}.json);
+# 2) a 4k-context pair where cache traffic dominates weights ~3:1.
+# Serial by design: NEVER two JAX processes through the relay at once.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/results/r04
+mkdir -p "$OUT"
+log() { echo "=== $(date +%H:%M:%S) $*"; }
+
+log "0. decode kernel compiled smoke (parity vs oracle on-chip)"
+timeout 900 python benchmarks/decode_attn_smoke.py \
+  | tail -1 | tee "$OUT/decode_attn_smoke.json"
+grep -q '"vs_baseline": 1.0' "$OUT/decode_attn_smoke.json" || {
+  echo "decode kernel smoke FAILED on-chip; skipping the A/B"; exit 1; }
+
+log "1. decode-attn A/B at 2k context (vs run 1's XLA rows)"
+timeout 1800 python benchmarks/lm_decode.py --prompt 1024 --maxlen 2048 \
+  --steps 128 --decode-attn pallas | tail -1 \
+  | tee "$OUT/lm_decode_long_native_pallas.json"
+timeout 1800 python benchmarks/lm_decode.py --prompt 1024 --maxlen 2048 \
+  --steps 128 --kv int8 --decode-attn pallas | tail -1 \
+  | tee "$OUT/lm_decode_long_int8_pallas.json"
+
+log "2. 4k context: cache bytes ~3x weight bytes"
+timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
+  --steps 128 | tail -1 | tee "$OUT/lm_decode_4k_native.json"
+timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
+  --steps 128 --decode-attn pallas | tail -1 \
+  | tee "$OUT/lm_decode_4k_native_pallas.json"
+timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
+  --steps 128 --kv int8 | tail -1 | tee "$OUT/lm_decode_4k_int8.json"
+timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
+  --steps 128 --kv int8 --decode-attn pallas | tail -1 \
+  | tee "$OUT/lm_decode_4k_int8_pallas.json"
+
+log "queue3 done"
