@@ -35,6 +35,7 @@ from jax import lax
 from adapt_tpu.graph.ir import INPUT, LayerGraph
 from adapt_tpu.ops.attention import flash_attention
 from adapt_tpu.ops.decode_attention import decode_attention
+from adapt_tpu.ops.paged_attention import paged_attention
 from adapt_tpu.ops.quantize import quantize_kv_vectors
 
 _NEG_INF = -1e30
@@ -250,6 +251,45 @@ class CausalSelfAttention(nn.Module):
         return self.out(o), cache_k, cache_v
 
 
+    def decode_step_paged(
+        self, x_t, k_pool, v_pool, page_table, index, valid_from=None,
+        attn_impl=None,
+    ):
+        """One token against a PAGED cache (``ops/paged_attention``):
+        write this step's K/V into the slot's physical page at
+        ``index``'s (page, offset), then attend over the table-mapped
+        window. ``index`` scalar or (b,) as in ``decode_step``; pools
+        are (num_pages, kv_h, P, hd); ``page_table`` (b, pages_per_slot)
+        int32 (idle rows may map everything to the trash page — their
+        writes land there, unread). Native-dtype pools only (int8 +
+        paging both buy capacity; compose them when a workload needs
+        both — see ``ops/paged_attention``)."""
+        b = x_t.shape[0]
+        page = k_pool.shape[2]
+        q, k, v = self._project(x_t)  # q (b, h, 1, hd); k/v (b, kv_h, 1, hd)
+        q = self._group_q(q)  # (b, kv_h, g, hd)
+        idx = jnp.broadcast_to(
+            jnp.asarray(index, jnp.int32).reshape(-1), (b,)
+        )
+        phys = jnp.take_along_axis(
+            page_table, (idx // page)[:, None], axis=1
+        )[:, 0]  # (b,) physical page of each row's write
+        off = idx % page
+        # Advanced-index scatter: rows (phys[i], :, off[i], :) <- token i.
+        k_pool = k_pool.at[phys, :, off, :].set(
+            k[:, :, 0, :].astype(k_pool.dtype)
+        )
+        v_pool = v_pool.at[phys, :, off, :].set(
+            v[:, :, 0, :].astype(v_pool.dtype)
+        )
+        o = paged_attention(
+            q, k_pool, v_pool, page_table, index, valid_from,
+            prefer=attn_impl,
+        ).astype(x_t.dtype)
+        o = self._ungroup_o(o, 1)
+        o = jnp.swapaxes(o, 1, 2).reshape(b, 1, self.dim)
+        return self.out(o), k_pool, v_pool
+
     def verify_chunk(self, x, cache_k, cache_v, index):
         """Append a CHUNK of ``K`` tokens at positions
         ``index..index+K-1`` in ONE cached pass — the speculative-decode
@@ -341,6 +381,17 @@ class DecoderBlock(nn.Module):
         )
         x_t = x_t + a
         return x_t + self._mlp(self.ln2(x_t)), ck, cv
+
+    def decode_step_paged(
+        self, x_t, k_pool, v_pool, page_table, index, valid_from=None,
+        attn_impl=None,
+    ):
+        a, kp, vp = self.attn.decode_step_paged(
+            self.ln1(x_t), k_pool, v_pool, page_table, index, valid_from,
+            attn_impl,
+        )
+        x_t = x_t + a
+        return x_t + self._mlp(self.ln2(x_t)), kp, vp
 
     def verify_chunk(self, x, cache_k, cache_v, index):
         a, ck, cv = self.attn.verify_chunk(

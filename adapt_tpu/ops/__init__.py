@@ -10,6 +10,10 @@ from adapt_tpu.ops.decode_attention import (
     decode_attention,
     decode_attention_reference,
 )
+from adapt_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+)
 
 __all__ = [
     "QuantizedTensor",
@@ -19,6 +23,8 @@ __all__ = [
     "dequantize",
     "dequantize_reference",
     "flash_attention",
+    "paged_attention",
+    "paged_attention_reference",
     "quantize",
     "quantize_reference",
 ]

@@ -1,0 +1,223 @@
+"""Paged decode attention: KV cache pages + a scalar-prefetched kernel.
+
+Contiguous per-slot KV caches (``runtime/continuous.py``) reserve
+``slots x max_len`` positions in HBM whatever the actual request mix —
+a short request in a long-context server wastes almost its whole strip.
+Paged KV (the vLLM idea, TPU-native here) carves the cache into
+fixed-size PAGES in one shared pool; each slot owns just the pages its
+live window touches, and a page table maps logical position blocks to
+physical pages. Capacity then scales with actual resident tokens, not
+with ``slots x max_len``.
+
+The TPU part: attention over a paged cache must NOT gather pages into a
+contiguous buffer first (that would write + re-read the whole window,
+doubling HBM traffic — the exact cost paging exists to avoid). The
+Pallas kernel here streams pages directly: the page table rides as a
+SCALAR-PREFETCH operand (``pltpu.PrefetchScalarGridSpec``), and the K/V
+``index_map`` consults it to pick each grid step's physical page — the
+DMA engine fetches pool blocks in table order while the online-softmax
+state carries across them. The kernel body is ``ops/decode_attention``'s
+(same masks, same skip of dead blocks past ``index``); only the block
+FETCH differs, which is the whole point: one attention discipline, two
+memory layouts.
+
+Layouts:
+- pool: (num_pages, kv_heads, page_size, head_dim), native dtype
+  (bf16/f32). int8 pools are future work — per-vector scale tiles need
+  the 1024-chunk trick of ``decode_attention``, which fights the small
+  page sizes paging wants; paging and int8 both buy capacity, compose
+  them when a workload needs both.
+- page table: (slots, pages_per_slot) int32 physical page ids; entries
+  past a slot's live window may be ANY valid page id (their positions
+  are masked, their blocks' compute skipped — point them at page 0).
+- q: (slots, kv_heads, g, head_dim) group-folded, as in
+  ``decode_attention``.
+
+``page_size`` must be a lane multiple (128); the grid streams
+``pages_per_slot`` blocks of ``page_size`` positions.
+
+No reference analog (SURVEY.md §2.2: the reference is CNN-only) — this
+is the framework's own serving-memory frontier.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from adapt_tpu.ops.decode_attention import _decode_kernel
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover — jax builds without pallas-tpu
+    pltpu = None
+    _VMEM = None
+
+DEFAULT_PAGE_SIZE = 128
+
+
+def paged_attention_reference(q, k_pool, v_pool, page_table, index,
+                              valid_from=None):
+    """jnp oracle: gather each slot's pages into a contiguous window,
+    then run the contiguous decode-attention oracle. This is the
+    semantics definition AND the materializing schedule the kernel
+    exists to beat.
+
+    q (b, kvh, g, hd); pools (num_pages, kvh, P, hd); page_table
+    (b, pages_per_slot) int32; index scalar or (b,)."""
+    from adapt_tpu.ops.decode_attention import decode_attention_reference
+
+    b = q.shape[0]
+    # (b, pages, kvh, P, hd) -> (b, kvh, pages*P, hd)
+    def gather(pool):
+        g_ = pool[page_table]  # (b, pages, kvh, P, hd)
+        g_ = jnp.moveaxis(g_, 2, 1)
+        return g_.reshape(b, pool.shape[1], -1, pool.shape[3])
+
+    return decode_attention_reference(
+        q, gather(k_pool), gather(v_pool), index, valid_from
+    )
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _paged_impl(q, k_pool, v_pool, page_table, index, valid_from):
+    b, kvh, g, hd = q.shape
+    num_pages, _, page, _ = k_pool.shape
+    pages_per_slot = page_table.shape[1]
+    cache_len = pages_per_slot * page
+    has_vf = valid_from is not None
+    pad_g = (-g) % 8
+    if pad_g:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_g), (0, 0)))
+    gq = g + pad_g
+    qf = q.reshape(b * kvh, gq, hd)
+    idx = jnp.repeat(
+        jnp.broadcast_to(jnp.asarray(index, jnp.int32).reshape(-1), (b,)),
+        kvh,
+    )
+    sm_scale = 1.0 / (hd ** 0.5)
+
+    # Scalar-prefetch operand 0: the page table, flattened with the idx /
+    # valid_from vectors appended is NOT needed — table stays 2-D; the
+    # kernel's SMEM scalars (idx, vf) remain ordinary SMEM inputs.
+    def q_map(bh, j, table_ref):
+        del j, table_ref
+        return (bh, 0, 0)
+
+    def kv_map(bh, j, table_ref):
+        return (table_ref[bh // kvh, j], bh % kvh, 0, 0)
+
+    def smem_map(bh, j, table_ref):
+        del j, table_ref
+        return (bh,)
+
+    def out_map(bh, j, table_ref):
+        del j, table_ref
+        return (bh, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, gq, hd), q_map, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
+        pl.BlockSpec((1,), smem_map, memory_space=pltpu.SMEM),
+    ]
+    operands = [qf, k_pool, v_pool, idx]
+    if has_vf:
+        operands.append(jnp.repeat(jnp.asarray(valid_from, jnp.int32), kvh))
+        in_specs.append(
+            pl.BlockSpec((1,), smem_map, memory_space=pltpu.SMEM)
+        )
+
+    kernel = functools.partial(
+        _paged_kernel,
+        block_k=page,
+        num_kv=pages_per_slot,
+        sm_scale=sm_scale,
+        has_vf=has_vf,
+    )
+    on_tpu = jax.default_backend() == "tpu"
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * kvh, pages_per_slot),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, gq, hd), out_map, memory_space=_VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((gq, 1), jnp.float32),
+            pltpu.VMEM((gq, 1), jnp.float32),
+            pltpu.VMEM((gq, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * kvh, gq, hd), q.dtype),
+        compiler_params=(
+            pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")
+            )
+            if on_tpu
+            else None
+        ),
+        interpret=not on_tpu,
+    )(jnp.asarray(page_table, jnp.int32), *operands)
+    del cache_len, num_pages
+    return out.reshape(b, kvh, gq, hd)[:, :, :g, :]
+
+
+def _paged_kernel(table_ref, q_ref, k_ref, v_ref, idx_ref, *refs, block_k,
+                  num_kv, sm_scale, has_vf):
+    """Scalar-prefetch wrapper: the table ref arrives first (consumed by
+    the index_maps, unused in the body) and the K/V tiles arrive as
+    (1, 1, page, hd) — drop the head axis and delegate to the contiguous
+    decode kernel body (one attention discipline, two layouts)."""
+    del table_ref
+    _decode_kernel(
+        q_ref,
+        k_ref.at[:, 0],
+        v_ref.at[:, 0],
+        idx_ref,
+        *refs,
+        block_k=block_k,
+        num_kv=num_kv,
+        sm_scale=sm_scale,
+        quantized=False,
+        has_vf=has_vf,
+    )
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    index,
+    valid_from=None,
+    prefer: str | None = None,
+) -> jax.Array:
+    """Decode attention over a paged KV cache.
+
+    ``prefer``: None = auto — the kernel on a real TPU whenever the page
+    size is a lane multiple (the gather oracle materializes the whole
+    window, the exact traffic paging exists to avoid), the oracle
+    everywhere else (off-TPU the kernel only has the Pallas INTERPRETER,
+    orders of magnitude slower than XLA's gather — tests opt in with
+    ``prefer="pallas"``). ``"pallas"`` / ``"xla"`` force."""
+    page = k_pool.shape[2]
+    supported = pltpu is not None and page % 128 == 0
+    if prefer is None:
+        on_tpu = jax.default_backend() == "tpu"
+        prefer = "pallas" if (supported and on_tpu) else "xla"
+    elif prefer not in ("pallas", "xla"):
+        raise ValueError(
+            f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
+        )
+    if prefer == "pallas" and supported:
+        return _paged_impl(q, k_pool, v_pool, page_table, index, valid_from)
+    return paged_attention_reference(
+        q, k_pool, v_pool, page_table, index, valid_from
+    )

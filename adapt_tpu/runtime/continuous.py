@@ -41,6 +41,15 @@ serving, TPU-first:
 vector, the same scheme as ``generate``): ~2x the resident context per
 slot and ~2x less per-step cache traffic vs bf16 caches.
 
+``kv_layout="paged"`` swaps the per-slot ``max_len`` strips for a shared
+page POOL (``runtime/paged`` allocator + ``ops/paged_attention``'s
+scalar-prefetch kernel): each request reserves just the pages its
+window needs and frees them on retirement, so HBM scales with resident
+tokens instead of ``slots x max_len`` — size it with ``pool_pages``
+(default: worst case, i.e. no saving until you lower it). Admission is
+FIFO all-or-nothing: a request that doesn't fit waits (head-of-line, no
+preemption in v1); one that can NEVER fit raises at ``submit``.
+
 ``top_k`` is per-REQUEST despite being shape-like (see
 ``_truncate_rows``); ticks with no truncating request skip the filter
 entirely via a static flag.
@@ -63,6 +72,7 @@ import numpy as np
 from jax import lax
 
 from adapt_tpu.models.transformer_lm import TransformerLM, nucleus_filter
+from adapt_tpu.runtime.paged import Pager, insert_prefill_pages
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
 
@@ -83,6 +93,7 @@ class _Request:
 
 @dataclasses.dataclass
 class _Slot:
+    idx: int = -1  # position in the slot list (page-table row)
     req: _Request | None = None
     s0: int = 0  # prompt length
     #: cache position where the next tick's CONSUMED token (last_token,
@@ -111,10 +122,13 @@ class ContinuousBatcher:
         prompt_buckets: tuple[int, ...] | None = None,
         chunk: int = 8,
         kv_cache_dtype: str = "native",
+        kv_layout: str = "slots",
+        page_size: int = 128,
+        pool_pages: int | None = None,
     ):
         self.lm = lm
         self.variables = variables
-        self.slots = [_Slot() for _ in range(slots)]
+        self.slots = [_Slot(idx=i) for i in range(slots)]
         self.top_k = top_k
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
@@ -124,10 +138,24 @@ class ContinuousBatcher:
                 f"kv_cache_dtype={kv_cache_dtype!r}: expected 'native' "
                 "or 'int8'"
             )
+        if kv_layout not in ("slots", "paged"):
+            raise ValueError(
+                f"kv_layout={kv_layout!r}: expected 'slots' or 'paged'"
+            )
+        if kv_layout == "paged" and kv_cache_dtype == "int8":
+            raise ValueError(
+                "kv_layout='paged' supports native caches only (int8 "
+                "pools are future work — see ops/paged_attention); both "
+                "are capacity knobs, pick one"
+            )
         #: int8 slot caches: absmax per K/V vector, same scheme as
         #: generate(kv_cache_dtype="int8") — ~2x more resident context
         #: per slot and ~2x less per-step cache traffic vs bf16.
         self._kv_quant = kv_cache_dtype == "int8"
+        #: paged caches: per-block page POOLS + a shared page table
+        #: (``runtime/paged`` allocator, ``ops/paged_attention`` kernel)
+        #: — HBM scales with resident tokens, not slots x max_len.
+        self._paged = kv_layout == "paged"
         if top_k is not None and not (1 <= top_k <= lm.vocab):
             raise ValueError(f"top_k {top_k} outside [1, {lm.vocab}]")
         if prompt_buckets is None:
@@ -148,17 +176,43 @@ class ContinuousBatcher:
         # (the whole point — slots cost kv_heads/heads the HBM).
         heads, head_dim = block0.cache_heads, block0.head_dim
 
-        def one_cache():
-            if self._kv_quant:
-                return (
-                    jnp.zeros((slots, heads, self._cache_len, head_dim),
-                              jnp.int8),
-                    jnp.zeros((slots, heads, self._cache_len, 1),
-                              jnp.float32),
+        if self._paged:
+            if page_size < 1:
+                raise ValueError(f"page_size must be >= 1, got {page_size}")
+            self._page = page_size
+            pps = -(-lm.max_len // page_size)  # ceil: table width
+            worst = slots * pps + 1  # every slot full + trash page
+            if pool_pages is None:
+                pool_pages = worst
+            if pool_pages < 2:
+                raise ValueError(
+                    f"pool_pages must be >= 2, got {pool_pages}"
                 )
-            return jnp.zeros(
-                (slots, heads, self._cache_len, head_dim), block0.dtype
-            )
+            self._pager = Pager(pool_pages, slots, pps)
+            self._pool_pages = pool_pages
+
+            def one_cache():
+                return jnp.zeros(
+                    (pool_pages, heads, page_size, head_dim), block0.dtype
+                )
+
+        else:
+            self._pager = None
+
+            def one_cache():
+                if self._kv_quant:
+                    return (
+                        jnp.zeros(
+                            (slots, heads, self._cache_len, head_dim),
+                            jnp.int8,
+                        ),
+                        jnp.zeros(
+                            (slots, heads, self._cache_len, 1), jnp.float32
+                        ),
+                    )
+                return jnp.zeros(
+                    (slots, heads, self._cache_len, head_dim), block0.dtype
+                )
 
         self._caches = [(one_cache(), one_cache()) for _ in lm.block_names]
         self._queue: collections.deque[_Request] = collections.deque()
@@ -202,16 +256,23 @@ class ContinuousBatcher:
         donate_argnums=(2,),
     )
     def _step_chunk(self, variables, caches, tokens, pos, keys, temps,
-                    top_ks, top_ps, greedy, *, truncate, nucleus):
+                    top_ks, top_ps, greedy, table=None, *, truncate,
+                    nucleus):
         """``chunk`` lockstep decode steps as one compiled scan.
 
         tokens/pos: (B,) int32 — per-slot input token and cache position
-        (inactive slots: trash). keys (chunk, B, 2) — each step's
-        per-slot sampling keys. temps / top_ks / top_ps / greedy (B,)
-        select per-row sampling; static ``truncate``/``nucleus`` elide
-        the top-k/top-p sorts when no active request needs them (at
-        most 2x2 compiled variants). Returns ((chunk, B) emitted
-        tokens, caches); ONE host sync per call, not per token."""
+        (inactive slots: the trash position, or position 0 of an
+        all-trash-page table row when paged). keys (chunk, B, 2) — each
+        step's per-slot sampling keys. temps / top_ks / top_ps / greedy
+        (B,) select per-row sampling; static ``truncate``/``nucleus``
+        elide the top-k/top-p sorts when no active request needs them
+        (at most 2x2 compiled variants). ``table`` (paged layout only)
+        addresses each block's (k_pool, v_pool) through the shared page
+        table — the cache plumbing is the ONLY thing that differs
+        between layouts; the sampling schedule is this one body.
+        Returns ((chunk, B) emitted tokens, caches); ONE host sync per
+        call, not per token."""
+        paged = table is not None
 
         def body(carry, step_keys):
             tokens, pos, caches = carry
@@ -220,14 +281,23 @@ class ContinuousBatcher:
                 method="embed_positions",
             )
             new_caches = []
-            for name, block, (ck, cv) in zip(
+            for name, block, cache in zip(
                 self.lm.block_names, self._blocks, caches
             ):
-                x, ck, cv = block.apply(
-                    variables[name], x, ck, cv, pos, None,
-                    self._kv_quant, method="decode_step",
-                )
-                new_caches.append((ck, cv))
+                if paged:
+                    kp, vp = cache
+                    x, kp, vp = block.apply(
+                        variables[name], x, kp, vp, table, pos, None,
+                        method="decode_step_paged",
+                    )
+                    new_caches.append((kp, vp))
+                else:
+                    ck, cv = cache
+                    x, ck, cv = block.apply(
+                        variables[name], x, ck, cv, pos, None,
+                        self._kv_quant, method="decode_step",
+                    )
+                    new_caches.append((ck, cv))
             logits = self._head.apply(variables["head"], x)[:, 0]  # (B, V)
             pick_greedy = jnp.argmax(logits, axis=-1)
             lg = logits / jnp.maximum(temps, 1e-6)[:, None]
@@ -245,6 +315,17 @@ class ContinuousBatcher:
             body, (tokens, pos, tuple(caches)), keys
         )
         return toks, list(caches)
+
+    def _insert_paged(self, caches, pages, kvs):
+        """Scatter a prefilled request's per-block K/V into its pages
+        (``runtime/paged.insert_prefill_pages`` per pool)."""
+        return [
+            (
+                insert_prefill_pages(kp, pages, ck),
+                insert_prefill_pages(vp, pages, cv),
+            )
+            for (kp, vp), (ck, cv) in zip(caches, kvs)
+        ]
 
     def _prefill_fn(self, bucket: int):
         """Jitted prefill for one prompt bucket: full causal forward over
@@ -327,6 +408,15 @@ class ContinuousBatcher:
                 f"prompt {s0} exceeds largest bucket "
                 f"{self.prompt_buckets[-1]}"
             )
+        if self._paged:
+            bucket = next(b for b in self.prompt_buckets if b >= s0)
+            need = -(-max(bucket, s0 + steps) // self._page)
+            if need > self._pool_pages - 1:  # page 0 is trash
+                # Would queue forever: the pool can never cover it.
+                raise ValueError(
+                    f"request needs {need} pages but the pool holds "
+                    f"{self._pool_pages - 1} allocatable"
+                )
         do_sample = temperature > 0.0
         if do_sample and rng is None:
             raise ValueError("temperature > 0 requires an rng key")
@@ -388,6 +478,10 @@ class ContinuousBatcher:
         global_metrics().inc("continuous.completed")
         slot.req = None
         slot.tokens = []
+        if self._paged:
+            # Pages return to the pool the moment the request retires —
+            # the capacity win continuous paging exists for.
+            self._pager.free_slot(slot.idx)
 
     def _commit(self, slot: _Slot, token: int) -> None:
         """Append one emitted token; EOS latches/finishes the request."""
@@ -414,6 +508,18 @@ class ContinuousBatcher:
                 req = self._queue.popleft()
             s0 = req.prompt.shape[0]
             bucket = next(b for b in self.prompt_buckets if b >= s0)
+            if self._paged:
+                # All-or-nothing reservation for the request's whole
+                # window (prefill writes `bucket` positions; decode
+                # reaches s0 + steps - 1). FIFO head-of-line: if the
+                # pool can't cover the next request, admission stops —
+                # later (smaller) requests do not jump it.
+                span = max(bucket, s0 + req.steps)
+                n_pages = -(-span // self._page)
+                if not self._pager.alloc(i, n_pages):
+                    with self._cv:
+                        self._queue.appendleft(req)
+                    return
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :s0] = req.prompt
             first, kvs = self._prefill_fn(bucket)(
@@ -428,11 +534,19 @@ class ContinuousBatcher:
                 truncate=req.top_k < self.lm.vocab,
                 nucleus=req.top_p < 1.0,
             )
-            # Pad each block's (1, h, bucket, hd) K/V to the cache length
-            # happens inside _insert via dynamic_update_slice bounds.
-            self._caches = self._insert(
-                self._caches, jnp.asarray(i, jnp.int32), kvs
-            )
+            if self._paged:
+                self._caches = self._insert_paged(
+                    self._caches,
+                    jnp.asarray(self._pager.owned(i), jnp.int32),
+                    kvs,
+                )
+            else:
+                # Pad each block's (1, h, bucket, hd) K/V to the cache
+                # length happens inside _insert via dynamic_update_slice
+                # bounds.
+                self._caches = self._insert(
+                    self._caches, jnp.asarray(i, jnp.int32), kvs
+                )
             slot.req = req
             slot.s0 = s0
             slot.pos = s0
@@ -457,7 +571,9 @@ class ContinuousBatcher:
             return 0
         B, C = len(self.slots), self.chunk
         tokens = np.zeros((B,), np.int32)
-        pos = np.full((B,), self._trash, np.int32)
+        # Idle rows: slot layout points at the trash POSITION; paged
+        # layout at position 0 of an all-trash-page table row.
+        pos = np.full((B,), 0 if self._paged else self._trash, np.int32)
         keys = np.zeros((C, B, 2), np.uint32)
         temps = np.zeros((B,), np.float32)
         top_ks = np.full((B,), self.lm.vocab, np.int32)
@@ -489,6 +605,7 @@ class ContinuousBatcher:
             jnp.asarray(top_ks),
             jnp.asarray(top_ps),
             jnp.asarray(greedy),
+            jnp.asarray(self._pager.table()) if self._paged else None,
             truncate=bool((top_ks < self.lm.vocab).any()),
             nucleus=bool((top_ps < 1.0).any()),
         )
@@ -519,7 +636,7 @@ class ContinuousBatcher:
         and THIS batcher's lifetime admit/complete/tick counts
         (instance-scoped — mirror counters also land in
         ``utils.metrics.global_metrics`` for process-level scraping)."""
-        return {
+        out = {
             "slots": len(self.slots),
             "active": sum(1 for s in self.slots if s.req is not None),
             "queued": len(self._queue),
@@ -528,6 +645,12 @@ class ContinuousBatcher:
             "completed": self._completed,
             "ticks": self._ticks,
         }
+        if self._paged:
+            ps = self._pager.stats()
+            out["pool_pages"] = ps.num_pages
+            out["pages_in_use"] = ps.in_use
+            out["pages_free"] = ps.free
+        return out
 
     def run(self, max_ticks: int = 100_000) -> dict[int, np.ndarray]:
         """Tick until every submitted request completed; returns

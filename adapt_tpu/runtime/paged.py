@@ -1,0 +1,112 @@
+"""Host-side page bookkeeping for the paged KV cache.
+
+The device side is ``ops/paged_attention`` (pools + the scalar-prefetch
+kernel); this module owns the ALLOCATOR: a free list of physical pages,
+per-slot page ownership, and the (slots, pages_per_slot) page table the
+compiled step consumes. All of it is plain numpy/python on the serving
+control path — page churn is a few integers per request, never worth a
+device round trip.
+
+Conventions (shared with ``ops/paged_attention``):
+- page 0 is the shared TRASH page: never allocated, the target of every
+  unallocated table entry and of idle slots' garbage writes. Reads of it
+  are always masked; concurrent garbage writes to it are unordered and
+  unread.
+- a slot's table row holds its pages in logical order; entries past its
+  allocation point at the trash page.
+
+No reference analog (SURVEY.md §2.2) — serving-memory frontier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class PagerStats:
+    num_pages: int  # total pool pages incl. trash
+    free: int
+    in_use: int  # excl. trash
+
+
+class Pager:
+    """Free-list page allocator over a pool of ``num_pages`` physical
+    pages (page 0 reserved as trash) for ``slots`` lockstep slots whose
+    table rows are ``pages_per_slot`` wide."""
+
+    def __init__(self, num_pages: int, slots: int, pages_per_slot: int):
+        if num_pages < 2:
+            raise ValueError(f"num_pages must be >= 2, got {num_pages}")
+        if pages_per_slot < 1:
+            raise ValueError(
+                f"pages_per_slot must be >= 1, got {pages_per_slot}"
+            )
+        self.num_pages = num_pages
+        self.pages_per_slot = pages_per_slot
+        # Pop from the end -> low page ids hand out first (determinism
+        # helps test reproducibility; no perf meaning).
+        self._free = list(range(num_pages - 1, 0, -1))
+        self._owned: list[list[int]] = [[] for _ in range(slots)]
+
+    def can_alloc(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def alloc(self, slot: int, n: int) -> bool:
+        """Grant ``n`` MORE pages to ``slot``; all-or-nothing. False if
+        the pool cannot cover it (caller leaves the request queued)."""
+        owned = self._owned[slot]
+        if len(owned) + n > self.pages_per_slot:
+            raise ValueError(
+                f"slot {slot}: {len(owned)}+{n} pages exceeds table "
+                f"width {self.pages_per_slot}"
+            )
+        if len(self._free) < n:
+            return False
+        for _ in range(n):
+            owned.append(self._free.pop())
+        return True
+
+    def free_slot(self, slot: int) -> None:
+        """Return all of ``slot``'s pages to the pool."""
+        self._free.extend(reversed(self._owned[slot]))
+        self._owned[slot] = []
+
+    def owned(self, slot: int) -> list[int]:
+        return list(self._owned[slot])
+
+    def table(self) -> np.ndarray:
+        """(slots, pages_per_slot) int32; unallocated entries -> trash
+        page 0."""
+        t = np.zeros((len(self._owned), self.pages_per_slot), np.int32)
+        for i, pages in enumerate(self._owned):
+            t[i, : len(pages)] = pages
+        return t
+
+    def stats(self) -> PagerStats:
+        in_use = sum(len(p) for p in self._owned)
+        return PagerStats(
+            num_pages=self.num_pages,
+            free=len(self._free),
+            in_use=in_use,
+        )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def insert_prefill_pages(pool, pages, kv):
+    """Scatter a prefilled request's contiguous (1, kv_h, S, hd) K or V
+    into its physical ``pages`` ((n,) int32, logical order). S pads up
+    to n*page positions — pad columns hold zeros that sit beyond the
+    prompt (masked until decode overwrites them). One scatter on the
+    page axis; jit specializes per (n, S), both bucket-bounded."""
+    n = pages.shape[0]
+    _, kvh, page, hd = pool.shape
+    s = kv.shape[2]
+    kvp = jnp.pad(kv[0], ((0, 0), (0, n * page - s), (0, 0)))
+    kvp = jnp.swapaxes(kvp.reshape(kvh, n, page, hd), 0, 1)
+    return pool.at[pages].set(kvp.astype(pool.dtype))

@@ -174,8 +174,13 @@ def main() -> int:
         record = {"metric": metric, "value": 0.0, "unit": "tokens/sec",
                   "vs_baseline": 0.0, "error": "child timed out"}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
-    with open(OUT, "w") as f:
-        json.dump(record, f, indent=1)
+    # Append (JSONL, one row per run) like speculative_decode.py: a
+    # failed TPU attempt must land BESIDE earlier measurements, never
+    # clobber them (r04 lesson: a relay error stub overwrote the only
+    # CPU datapoint).
+    mode = "a" if os.path.exists(OUT) else "w"
+    with open(OUT, mode) as f:
+        json.dump(record, f)
         f.write("\n")
     print(json.dumps(record), flush=True)
     return 0
