@@ -87,9 +87,8 @@ def paged_attention_reference(q, k_pool, v_pool, page_table, index,
 @functools.partial(jax.jit, static_argnames=())
 def _paged_impl(q, k_pool, v_pool, page_table, index, valid_from):
     b, kvh, g, hd = q.shape
-    num_pages, _, page, _ = k_pool.shape
+    page = k_pool.shape[2]
     pages_per_slot = page_table.shape[1]
-    cache_len = pages_per_slot * page
     has_vf = valid_from is not None
     pad_g = (-g) % 8
     if pad_g:
@@ -165,7 +164,6 @@ def _paged_impl(q, k_pool, v_pool, page_table, index, valid_from):
         ),
         interpret=not on_tpu,
     )(jnp.asarray(page_table, jnp.int32), *operands)
-    del cache_len, num_pages
     return out.reshape(b, kvh, gq, hd)[:, :, :g, :]
 
 

@@ -50,6 +50,15 @@ tokens instead of ``slots x max_len`` — size it with ``pool_pages``
 FIFO all-or-nothing: a request that doesn't fit waits (head-of-line, no
 preemption in v1); one that can NEVER fit raises at ``submit``.
 
+Paged slots get PREFIX CACHING for free: a full page of prompt K/V is
+content-addressed (hash of the whole token prefix it depends on) and
+refcounted, so a request whose prompt starts with an already-resident
+prefix — the shared-system-prompt workload — shares those pages (live
+or retired) and prefills only its suffix in one ``verify_chunk`` pass.
+Retired pages linger as an evict-under-pressure LRU. Hit/miss/cached
+counts surface in :meth:`stats`; outputs stay token-identical to solo
+``generate()`` (tested, including two live requests sharing pages).
+
 ``top_k`` is per-REQUEST despite being shape-like (see
 ``_truncate_rows``); ticks with no truncating request skip the filter
 entirely via a static flag.
@@ -72,7 +81,12 @@ import numpy as np
 from jax import lax
 
 from adapt_tpu.models.transformer_lm import TransformerLM, nucleus_filter
-from adapt_tpu.runtime.paged import Pager, insert_prefill_pages
+from adapt_tpu.runtime.paged import (
+    Pager,
+    gather_pages as _gather_pages,
+    insert_prefill_pages,
+    scatter_strip_pages,
+)
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
 
@@ -327,6 +341,21 @@ class ContinuousBatcher:
             for (kp, vp), (ck, cv) in zip(caches, kvs)
         ]
 
+    def _first_pick(self, h_last, variables, keys, temp, top_k, top_p,
+                    greedy, truncate, nucleus):
+        """Shared first-token sampling tail of both prefill flavors —
+        the exact knob semantics of ``submit`` (one body, cannot
+        fork)."""
+        logits = self._head.apply(variables["head"], h_last)[:, 0]
+        pick_greedy = jnp.argmax(logits, axis=-1)
+        lg = logits / jnp.maximum(temp, 1e-6)
+        if truncate:
+            lg = self._truncate_rows(lg, top_k[None])
+        if nucleus:
+            lg = nucleus_filter(lg, top_p[None])
+        sampled = jax.vmap(jax.random.categorical)(keys, lg)
+        return jnp.where(greedy, pick_greedy, sampled)
+
     def _prefill_fn(self, bucket: int):
         """Jitted prefill for one prompt bucket: full causal forward over
         (1, bucket), logits at the TRUE last position, per-block K/V to
@@ -346,18 +375,60 @@ class ContinuousBatcher:
                 )
                 kvs.append((ck, cv))
             h_last = lax.dynamic_index_in_dim(h, true_len - 1, 1)
-            logits = self._head.apply(variables["head"], h_last)[:, 0]
-            pick_greedy = jnp.argmax(logits, axis=-1)
-            lg = logits / jnp.maximum(temp, 1e-6)
-            if truncate:
-                lg = self._truncate_rows(lg, top_k[None])
-            if nucleus:
-                lg = nucleus_filter(lg, top_p[None])
-            sampled = jax.vmap(jax.random.categorical)(keys, lg)
-            first = jnp.where(greedy, pick_greedy, sampled)
+            first = self._first_pick(
+                h_last, variables, keys, temp, top_k, top_p, greedy,
+                truncate, nucleus,
+            )
             return first, kvs
 
         self._prefill_cache[bucket] = prefill
+        return prefill
+
+    def _prefill_suffix_fn(self, sbucket: int, n_strip: int):
+        """Jitted SUFFIX prefill (paged prefix-cache hit): the first m
+        pages of the slot's window already hold shared prompt K/V; only
+        the suffix runs the forward. Per block: gather the working strip
+        from the pools, append the suffix in one ``verify_chunk`` pass
+        (each suffix row attends the strip up to its own position — the
+        speculative-verify primitive reused as incremental prefill),
+        scatter the NEW pages back (shared pages are immutable; their
+        strip copies land in the trash page). Specializes per
+        (suffix bucket, strip pages) — a stable system-prompt workload
+        sees a handful of variants."""
+        key = ("suffix", sbucket, n_strip)
+        if key in self._prefill_cache:
+            return self._prefill_cache[key]
+        page = self._page
+
+        @partial(jax.jit, static_argnames=("truncate", "nucleus"),
+                 donate_argnums=(1,))
+        def prefill(variables, caches, pages, ids, pos0, true_len, keys,
+                    temp, top_k, top_p, greedy, *, truncate, nucleus):
+            pos_ids = pos0 + jnp.arange(sbucket)[None]
+            h = self._embed.apply(
+                variables["embed"], ids, pos_ids, method="embed_positions"
+            )
+            start_page = pos0 // page
+            new_caches = []
+            for name, block, (kp, vp) in zip(
+                self.lm.block_names, self._blocks, caches
+            ):
+                sk = _gather_pages(kp, pages)
+                sv = _gather_pages(vp, pages)
+                h, sk, sv = block.apply(
+                    variables[name], h, sk, sv, pos0, method="verify_chunk"
+                )
+                kp = scatter_strip_pages(kp, pages, sk, start_page)
+                vp = scatter_strip_pages(vp, pages, sv, start_page)
+                new_caches.append((kp, vp))
+            h_last = lax.dynamic_index_in_dim(h, true_len - 1, 1)
+            first = self._first_pick(
+                h_last, variables, keys, temp, top_k, top_p, greedy,
+                truncate, nucleus,
+            )
+            return first, new_caches
+
+        self._prefill_cache[key] = prefill
         return prefill
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
@@ -508,45 +579,98 @@ class ContinuousBatcher:
                 req = self._queue.popleft()
             s0 = req.prompt.shape[0]
             bucket = next(b for b in self.prompt_buckets if b >= s0)
+            m = 0
             if self._paged:
-                # All-or-nothing reservation for the request's whole
-                # window (prefill writes `bucket` positions; decode
-                # reaches s0 + steps - 1). FIFO head-of-line: if the
-                # pool can't cover the next request, admission stops —
-                # later (smaller) requests do not jump it.
+                # Prefix probe: acquire (rc+1) every already-cached FULL
+                # prompt page, longest run first-miss-stops. Cap at the
+                # page before the last prompt token so the suffix
+                # forward is never empty (the first sampled token needs
+                # a live last-position hidden state).
+                P = self._page
+                for j in range((s0 - 1) // P):
+                    key = Pager.prefix_key(req.prompt, (j + 1) * P)
+                    if self._pager.lookup_share(i, key) is None:
+                        break
+                    m += 1
+                # All-or-nothing reservation for the REST of the window
+                # (prefill writes `bucket` positions; decode reaches
+                # s0 + steps - 1). FIFO head-of-line: if the pool can't
+                # cover the next request, admission stops — later
+                # (smaller) requests do not jump it.
                 span = max(bucket, s0 + req.steps)
-                n_pages = -(-span // self._page)
+                n_pages = -(-span // P) - m
                 if not self._pager.alloc(i, n_pages):
+                    self._pager.free_slot(i)  # releases the shares too
                     with self._cv:
                         self._queue.appendleft(req)
                     return
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, :s0] = req.prompt
-            first, kvs = self._prefill_fn(bucket)(
-                self.variables,
-                jnp.asarray(ids),
-                jnp.asarray(s0, jnp.int32),
-                jnp.asarray(req.folded_keys[0][None]),
-                jnp.asarray(req.temperature, jnp.float32),
-                jnp.asarray(req.top_k, jnp.int32),
-                jnp.asarray(req.top_p, jnp.float32),
-                jnp.asarray(req.temperature == 0.0),
-                truncate=req.top_k < self.lm.vocab,
-                nucleus=req.top_p < 1.0,
-            )
-            if self._paged:
-                self._caches = self._insert_paged(
+            if m:
+                # Suffix-only prefill against the shared prefix pages.
+                # The suffix pads to whole PAGES, not prompt buckets —
+                # page rounding keeps the strip inside the reserved
+                # window by construction (ceil(s0/P) <= ceil(span/P)),
+                # where bucket rounding could round past it.
+                slen = s0 - m * self._page
+                sbucket = -(-slen // self._page) * self._page
+                n_strip = m + sbucket // self._page
+                owned = self._pager.owned(i)
+                assert n_strip <= len(owned)
+                ids = np.zeros((1, sbucket), np.int32)
+                ids[0, :slen] = req.prompt[m * self._page:]
+                first, self._caches = self._prefill_suffix_fn(
+                    sbucket, n_strip
+                )(
+                    self.variables,
                     self._caches,
-                    jnp.asarray(self._pager.owned(i), jnp.int32),
-                    kvs,
+                    jnp.asarray(owned[:n_strip], jnp.int32),
+                    jnp.asarray(ids),
+                    jnp.asarray(m * self._page, jnp.int32),
+                    jnp.asarray(slen, jnp.int32),
+                    jnp.asarray(req.folded_keys[0][None]),
+                    jnp.asarray(req.temperature, jnp.float32),
+                    jnp.asarray(req.top_k, jnp.int32),
+                    jnp.asarray(req.top_p, jnp.float32),
+                    jnp.asarray(req.temperature == 0.0),
+                    truncate=req.top_k < self.lm.vocab,
+                    nucleus=req.top_p < 1.0,
                 )
             else:
-                # Pad each block's (1, h, bucket, hd) K/V to the cache
-                # length happens inside _insert via dynamic_update_slice
-                # bounds.
-                self._caches = self._insert(
-                    self._caches, jnp.asarray(i, jnp.int32), kvs
+                ids = np.zeros((1, bucket), np.int32)
+                ids[0, :s0] = req.prompt
+                first, kvs = self._prefill_fn(bucket)(
+                    self.variables,
+                    jnp.asarray(ids),
+                    jnp.asarray(s0, jnp.int32),
+                    jnp.asarray(req.folded_keys[0][None]),
+                    jnp.asarray(req.temperature, jnp.float32),
+                    jnp.asarray(req.top_k, jnp.int32),
+                    jnp.asarray(req.top_p, jnp.float32),
+                    jnp.asarray(req.temperature == 0.0),
+                    truncate=req.top_k < self.lm.vocab,
+                    nucleus=req.top_p < 1.0,
                 )
+                if self._paged:
+                    self._caches = self._insert_paged(
+                        self._caches,
+                        jnp.asarray(self._pager.owned(i), jnp.int32),
+                        kvs,
+                    )
+                else:
+                    # Pad each block's (1, h, bucket, hd) K/V to the
+                    # cache length happens inside _insert via
+                    # dynamic_update_slice bounds.
+                    self._caches = self._insert(
+                        self._caches, jnp.asarray(i, jnp.int32), kvs
+                    )
+            if self._paged:
+                # Publish this request's full prompt pages for future
+                # sharing (first writer wins; the shared ones are
+                # already registered).
+                owned = self._pager.owned(i)
+                for j in range(m, s0 // self._page):
+                    self._pager.register(
+                        owned[j], Pager.prefix_key(req.prompt, (j + 1) * self._page)
+                    )
             slot.req = req
             slot.s0 = s0
             slot.pos = s0
@@ -650,6 +774,9 @@ class ContinuousBatcher:
             out["pool_pages"] = ps.num_pages
             out["pages_in_use"] = ps.in_use
             out["pages_free"] = ps.free
+            out["pages_cached"] = ps.cached
+            out["prefix_hits"] = ps.prefix_hits
+            out["prefix_misses"] = ps.prefix_misses
         return out
 
     def run(self, max_ticks: int = 100_000) -> dict[int, np.ndarray]:

@@ -7,6 +7,16 @@ compiled step consumes. All of it is plain numpy/python on the serving
 control path — page churn is a few integers per request, never worth a
 device round trip.
 
+Prefix caching rides on the same bookkeeping: a FULL page of prompt
+K/V is immutable once written (position p's K/V depend only on tokens
+[0..p], so page i is determined by tokens[0..(i+1)*page_size)), which
+makes the page the natural sharing unit. Pages carry REFCOUNTS; a
+finished request's registered pages drop to rc=0 but stay resident in
+an LRU of evictables, and a later request whose prompt hashes to the
+same content keys shares them (rc+1) instead of recomputing —
+``lookup_share`` / ``register``. Allocation evicts rc=0 cached pages
+only under pool pressure, oldest first.
+
 Conventions (shared with ``ops/paged_attention``):
 - page 0 is the shared TRASH page: never allocated, the target of every
   unallocated table entry and of idle slots' garbage writes. Reads of it
@@ -20,6 +30,7 @@ No reference analog (SURVEY.md §2.2) — serving-memory frontier.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 from functools import partial
 
@@ -31,14 +42,18 @@ import numpy as np
 @dataclasses.dataclass
 class PagerStats:
     num_pages: int  # total pool pages incl. trash
-    free: int
-    in_use: int  # excl. trash
+    free: int  # immediately allocatable (free list + evictable cache)
+    in_use: int  # rc > 0, excl. trash
+    cached: int  # rc == 0 but resident for prefix reuse
+    prefix_hits: int
+    prefix_misses: int
 
 
 class Pager:
-    """Free-list page allocator over a pool of ``num_pages`` physical
-    pages (page 0 reserved as trash) for ``slots`` lockstep slots whose
-    table rows are ``pages_per_slot`` wide."""
+    """Free-list page allocator with refcounted prefix sharing over a
+    pool of ``num_pages`` physical pages (page 0 reserved as trash) for
+    ``slots`` lockstep slots whose table rows are ``pages_per_slot``
+    wide."""
 
     def __init__(self, num_pages: int, slots: int, pages_per_slot: int):
         if num_pages < 2:
@@ -53,28 +68,62 @@ class Pager:
         # helps test reproducibility; no perf meaning).
         self._free = list(range(num_pages - 1, 0, -1))
         self._owned: list[list[int]] = [[] for _ in range(slots)]
+        self._rc: dict[int, int] = {}
+        # Content-addressed prefix registry: key -> page, both ways.
+        self._by_key: dict[bytes, int] = {}
+        self._key_of: dict[int, bytes] = {}
+        # rc==0 registered pages, oldest-first (eviction order).
+        self._lru: collections.OrderedDict[int, None] = (
+            collections.OrderedDict()
+        )
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+
+    # -- raw pages ---------------------------------------------------------
+
+    def _take_one(self) -> int | None:
+        if self._free:
+            return self._free.pop()
+        if self._lru:  # evict the coldest cached prefix page
+            page, _ = self._lru.popitem(last=False)
+            key = self._key_of.pop(page)
+            del self._by_key[key]
+            return page
+        return None
 
     def can_alloc(self, n: int) -> bool:
-        return len(self._free) >= n
+        return len(self._free) + len(self._lru) >= n
 
     def alloc(self, slot: int, n: int) -> bool:
         """Grant ``n`` MORE pages to ``slot``; all-or-nothing. False if
-        the pool cannot cover it (caller leaves the request queued)."""
+        the pool cannot cover it even after evicting every rc=0 cached
+        page (caller leaves the request queued)."""
         owned = self._owned[slot]
         if len(owned) + n > self.pages_per_slot:
             raise ValueError(
                 f"slot {slot}: {len(owned)}+{n} pages exceeds table "
                 f"width {self.pages_per_slot}"
             )
-        if len(self._free) < n:
+        if not self.can_alloc(n):
             return False
         for _ in range(n):
-            owned.append(self._free.pop())
+            page = self._take_one()
+            self._rc[page] = 1
+            owned.append(page)
         return True
 
     def free_slot(self, slot: int) -> None:
-        """Return all of ``slot``'s pages to the pool."""
-        self._free.extend(reversed(self._owned[slot]))
+        """Drop ``slot``'s claim on all its pages. rc=0 pages return to
+        the free list — unless registered as prefix cache, in which
+        case they stay resident and evictable (LRU)."""
+        for page in reversed(self._owned[slot]):
+            self._rc[page] -= 1
+            if self._rc[page] == 0:
+                del self._rc[page]
+                if page in self._key_of:
+                    self._lru[page] = None  # newest = last evicted
+                else:
+                    self._free.append(page)
         self._owned[slot] = []
 
     def owned(self, slot: int) -> list[int]:
@@ -88,12 +137,46 @@ class Pager:
             t[i, : len(pages)] = pages
         return t
 
+    # -- prefix sharing ----------------------------------------------------
+
+    @staticmethod
+    def prefix_key(tokens: np.ndarray, upto: int) -> bytes:
+        """Content key for the page covering positions [upto-P, upto):
+        the whole prompt prefix [0, upto) (K/V at position p depend on
+        every earlier token, so the key must cover them all)."""
+        return np.ascontiguousarray(tokens[:upto], np.int32).tobytes()
+
+    def lookup_share(self, slot: int, key: bytes) -> int | None:
+        """If ``key``'s page is resident, acquire it for ``slot``
+        (rc+1, out of the eviction LRU) and return it."""
+        page = self._by_key.get(key)
+        if page is None:
+            self.prefix_misses += 1
+            return None
+        if len(self._owned[slot]) + 1 > self.pages_per_slot:
+            return None  # table row full — cannot take the share
+        self._lru.pop(page, None)
+        self._rc[page] = self._rc.get(page, 0) + 1
+        self._owned[slot].append(page)
+        self.prefix_hits += 1
+        return page
+
+    def register(self, page: int, key: bytes) -> None:
+        """Publish ``page`` (currently owned, rc>=1) as the cache entry
+        for ``key``. First writer wins; a page may carry one key."""
+        if key in self._by_key or page in self._key_of:
+            return
+        self._by_key[key] = page
+        self._key_of[page] = key
+
     def stats(self) -> PagerStats:
-        in_use = sum(len(p) for p in self._owned)
         return PagerStats(
             num_pages=self.num_pages,
-            free=len(self._free),
-            in_use=in_use,
+            free=len(self._free) + len(self._lru),
+            in_use=sum(1 for r in self._rc.values() if r > 0),
+            cached=len(self._lru),
+            prefix_hits=self.prefix_hits,
+            prefix_misses=self.prefix_misses,
         )
 
 
@@ -110,3 +193,29 @@ def insert_prefill_pages(pool, pages, kv):
     kvp = jnp.pad(kv[0], ((0, 0), (0, n * page - s), (0, 0)))
     kvp = jnp.swapaxes(kvp.reshape(kvh, n, page, hd), 0, 1)
     return pool.at[pages].set(kvp.astype(pool.dtype))
+
+
+@jax.jit
+def gather_pages(pool, pages):
+    """(n,) physical pages -> one contiguous (1, kv_h, n*P, hd) working
+    strip (the suffix-prefill staging form; decode never gathers — the
+    kernel streams pages in place)."""
+    g = pool[pages]  # (n, kvh, P, hd)
+    _, kvh, page, hd = pool.shape
+    return jnp.moveaxis(g, 1, 0).reshape(1, kvh, -1, hd)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def scatter_strip_pages(pool, pages, strip, start_page: jax.Array):
+    """Write a contiguous (1, kv_h, W, hd) working strip's pages back
+    into the pool, SKIPPING the first ``start_page`` logical pages
+    (shared prefix pages are immutable — only the suffix's pages land).
+    ``pages`` is the full (n,) logical->physical map; skipped entries
+    scatter into the trash page instead of their (shared) target."""
+    n = pages.shape[0]
+    _, kvh, page, hd = pool.shape
+    w = strip.shape[2]
+    sp = jnp.pad(strip[0], ((0, 0), (0, n * page - w), (0, 0)))
+    sp = jnp.swapaxes(sp.reshape(kvh, n, page, hd), 0, 1)  # (n,kvh,P,hd)
+    dest = jnp.where(jnp.arange(n) < start_page, 0, pages)
+    return pool.at[dest].set(sp.astype(pool.dtype))

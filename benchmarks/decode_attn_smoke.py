@@ -1,10 +1,12 @@
-"""Compiled on-chip smoke of the decode-attention Pallas kernel.
+"""Compiled on-chip smoke of the decode-attention Pallas kernels.
 
 The decode kernel's one Mosaic-lowering risk is the scale-tile reshape
-((8, 128) chunk -> (1, 1024) score-column row). This driver runs the
-kernel COMPILED on the real chip across its shape classes (native/int8,
-MHA/GQA rows, scalar/per-row index, ragged) and checks each against the
-einsum oracle — the same checks `tests/test_decode_attention.py` runs in
+((8, 128) chunk -> (1, 1024) score-column row); the paged kernel's is
+the scalar-prefetched page-table index_map (PrefetchScalarGridSpec).
+This driver runs both COMPILED on the real chip across their shape
+classes (native/int8, MHA/GQA rows, scalar/per-row index, ragged,
+paged) and checks each against the einsum oracle — the same checks
+`tests/test_decode_attention.py` / `tests/test_paged.py` run in
 interpreter mode. One JSON line; nonzero exit if any class fails to
 compile or mismatches.
 
@@ -72,6 +74,30 @@ def _child() -> None:
             jnp.asarray([0, 900, 5, 300], jnp.int32) if ragged else None
         )
         check(name, q, ck, cv, index, vf)
+
+    # Paged kernel: same bar against ITS oracle (gather + einsum).
+    from adapt_tpu.ops.paged_attention import (
+        paged_attention,
+        paged_attention_reference,
+    )
+
+    kq, kk, kv_ = jax.random.split(jax.random.fold_in(rng, 77), 3)
+    npages, page, pps = 40, 128, 8  # b*pps = 32 distinct non-trash pages
+    q = jax.random.normal(kq, (b, kvh, 1, hd), jnp.float32)
+    kp = jax.random.normal(kk, (npages, kvh, page, hd), jnp.float32)
+    vp = jax.random.normal(kv_, (npages, kvh, page, hd), jnp.float32)
+    perm = np.random.RandomState(0).permutation(npages - 1) + 1
+    table = jnp.asarray(
+        perm[: b * pps].reshape(b, pps), jnp.int32
+    )
+    index = jnp.asarray([1000, 513, 128, 17], jnp.int32)
+    ref = np.asarray(paged_attention_reference(q, kp, vp, table, index))
+    out = np.asarray(
+        paged_attention(q, kp, vp, table, index, prefer="pallas")
+    )
+    err = float(np.max(np.abs(out - ref)))
+    cases.append({"case": "paged_mha_8pages", "max_err": err,
+                  "ok": err < 2e-3})
 
     ok = all(c["ok"] for c in cases)
     print(

@@ -38,4 +38,9 @@ timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
   --steps 128 --kv int8 --decode-attn pallas | tail -1 \
   | tee "$OUT/lm_decode_4k_int8_pallas.json"
 
+log "3. continuous batching at serving scale (retry; run 2 hit a relay error)"
+timeout 2700 python benchmarks/continuous_serve.py --slots 8 \
+  --requests 32 --chunk 16 | tail -1
+# (driver appends a JSONL row to results/r04/continuous_serve.json)
+
 log "queue3 done"

@@ -104,6 +104,15 @@ def lm_setup():
     return lm, variables
 
 
+@pytest.fixture(scope="module")
+def lm_setup_64():
+    lm = lm_tiny(vocab=37, max_len=64)
+    variables = lm.graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )
+    return lm, variables
+
+
 def _solo(lm, variables, prompt, steps, **kw):
     return np.asarray(
         generate(lm, variables, jnp.asarray(prompt)[None], steps, **kw)
@@ -169,6 +178,118 @@ def test_paged_small_pool_forces_waiting_but_completes(lm_setup):
     for rid, i in ids.items():
         want = _solo(lm, variables, prompts[i], steps[i])
         np.testing.assert_array_equal(out[rid], want, err_msg=f"req {i}")
+
+
+def test_prefix_cache_reuses_pages_across_requests(lm_setup):
+    """Same prompt served twice: the second admission shares the first's
+    registered full pages (prefix hits, fewer fresh allocations) and
+    still emits exactly the solo generate() stream — suffix-only
+    prefill must be invisible in outputs."""
+    lm, variables = lm_setup
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(0, 37, size=37).astype(np.int32)  # 2 full pages
+    bat = ContinuousBatcher(
+        lm, variables, slots=2, chunk=4, kv_layout="paged", page_size=16
+    )
+    r1 = bat.submit(prompt, 5)
+    out1 = bat.run()
+    assert bat._pager.stats().cached == 2  # two full pages registered
+    r2 = bat.submit(prompt, 5)
+    out2 = bat.run()
+    want = _solo(lm, variables, prompt, 5)
+    np.testing.assert_array_equal(out1[r1], want)
+    np.testing.assert_array_equal(out2[r2], want)
+    st = bat._pager.stats()
+    assert st.prefix_hits == 2 and st.cached == 2
+
+
+def test_prefix_cache_shared_system_prompt_live(lm_setup):
+    """Two DIFFERENT requests sharing a long system prefix, resident
+    simultaneously: the common full pages are shared in flight (rc=2 —
+    observable as fewer pages in use than two solo windows) and both
+    streams match solo generate()."""
+    lm, variables = lm_setup
+    rng = np.random.RandomState(8)
+    system = rng.randint(0, 37, size=32).astype(np.int32)  # 2 full pages
+    p1 = np.concatenate([system, rng.randint(0, 37, size=4).astype(np.int32)])
+    p2 = np.concatenate([system, rng.randint(0, 37, size=7).astype(np.int32)])
+    bat = ContinuousBatcher(
+        lm, variables, slots=2, chunk=4, kv_layout="paged", page_size=16
+    )
+    r1 = bat.submit(p1, 4)
+    bat.tick()  # admit + register p1's prefix pages
+    r2 = bat.submit(p2, 4,
+                    temperature=0.8, top_k=6, rng=jax.random.PRNGKey(11))
+    bat.tick()  # p2 admits against p1's live pages
+    st = bat._pager.stats()
+    # Window per request = ceil(max(bucket=48? (36/43 -> 64), s0+4)/16)
+    # pages; sharing saves 2 of them while both are live.
+    assert bat._pager.prefix_hits == 2
+    out = bat.run()
+    np.testing.assert_array_equal(out[r1], _solo(lm, variables, p1, 4))
+    np.testing.assert_array_equal(
+        out[r2],
+        _solo(lm, variables, p2, 4, temperature=0.8, top_k=6,
+              rng=jax.random.PRNGKey(11)),
+    )
+    assert st.in_use < 2 * (-(-max(64, p1.shape[0] + 4) // 16))
+
+
+def test_prefix_cache_eviction_under_pressure(lm_setup):
+    """A pool with no spare room: cached (rc=0) prefix pages are evicted
+    to admit an unrelated request, and serving stays correct."""
+    lm, variables = lm_setup
+    rng = np.random.RandomState(9)
+    p_a = rng.randint(0, 37, size=33).astype(np.int32)
+    p_b = rng.randint(0, 37, size=33).astype(np.int32)
+    # Window: bucket 48? buckets are powers of two + max_len: 8,16,32,48
+    # -> 33 fits bucket 48 (max_len); span max(48, 39) = 48 -> 3 pages.
+    bat = ContinuousBatcher(
+        lm, variables, slots=1, chunk=4, kv_layout="paged", page_size=16,
+        pool_pages=4,  # exactly one window + trash: b must evict a's pages
+    )
+    ra = bat.submit(p_a, 5)
+    out_a = bat.run()
+    assert bat._pager.stats().cached == 2
+    rb = bat.submit(p_b, 5)
+    out_b = bat.run()
+    np.testing.assert_array_equal(out_a[ra], _solo(lm, variables, p_a, 5))
+    np.testing.assert_array_equal(out_b[rb], _solo(lm, variables, p_b, 5))
+    # a's cached pages were evicted to make room; b's now sit in cache.
+    assert bat._pager.stats().cached == 2
+    # And a THIRD submit of p_a must recompute (its pages are gone) yet
+    # still match.
+    ra2 = bat.submit(p_a, 5)
+    out_a2 = bat.run()
+    np.testing.assert_array_equal(out_a2[ra2], _solo(lm, variables, p_a, 5))
+
+
+def test_prefix_hit_suffix_bucket_rounds_past_span(lm_setup_64):
+    """Regression: a short prefix hit (m=1) whose SUFFIX bucket
+    re-rounds past the request's own span page count — the reservation
+    must cover the suffix prefill's working strip, or _admit crashes
+    (or silently corrupts shared pages under -O). s0=49, steps=5,
+    P=16: span 64 -> 4 pages, but suffix 33 -> bucket 64 -> strip
+    needs 5."""
+    lm, variables = lm_setup_64
+    rng = np.random.RandomState(11)
+    first = rng.randint(0, 37, size=49).astype(np.int32)
+    second = first.copy()
+    second[20] = (second[20] + 1) % 37  # shares ONLY the first page
+    bat = ContinuousBatcher(
+        lm, variables, slots=1, chunk=4, kv_layout="paged", page_size=16
+    )
+    r1 = bat.submit(first, 5)
+    out1 = bat.run()
+    r2 = bat.submit(second, 5)
+    out2 = bat.run()
+    assert bat._pager.prefix_hits == 1  # page 0 shared, page 1 missed
+    np.testing.assert_array_equal(
+        out1[r1], _solo(lm, variables, first, 5)
+    )
+    np.testing.assert_array_equal(
+        out2[r2], _solo(lm, variables, second, 5)
+    )
 
 
 def test_paged_validation(lm_setup):
