@@ -271,10 +271,18 @@ class CausalSelfAttention(nn.Module):
         idx = jnp.broadcast_to(
             jnp.asarray(index, jnp.int32).reshape(-1), (b,)
         )
+        # Negative index = dead row (idle or mid-chunked-prefill slot in
+        # a lockstep batch). Its garbage write MUST go to the trash page
+        # — the row may own real pages (a prefilling slot does), and
+        # table[row, 0] would be prompt page 0. Attention masks every
+        # position (cols <= negative is empty), so nothing reads back.
+        live_row = idx >= 0
+        safe = jnp.maximum(idx, 0)
         phys = jnp.take_along_axis(
-            page_table, (idx // page)[:, None], axis=1
+            page_table, (safe // page)[:, None], axis=1
         )[:, 0]  # (b,) physical page of each row's write
-        off = idx % page
+        phys = jnp.where(live_row, phys, 0)
+        off = safe % page
         # Advanced-index scatter: rows (phys[i], :, off[i], :) <- token i.
         k_pool = k_pool.at[phys, :, off, :].set(
             k[:, :, 0, :].astype(k_pool.dtype)

@@ -50,6 +50,17 @@ tokens instead of ``slots x max_len`` — size it with ``pool_pages``
 FIFO all-or-nothing: a request that doesn't fit waits (head-of-line, no
 preemption in v1); one that can NEVER fit raises at ``submit``.
 
+``prefill_chunk`` (paged only, a multiple of ``page_size``) turns a
+long prompt's admission into CHUNKED PREFILL: one page-aligned chunk
+pass per tick, interleaved with the decode batch, so a long admission
+never stalls the requests already decoding (the Sarathi-style
+latency/throughput knob; ``None`` = whole-prompt prefill, the default).
+Numerical contract: greedy streams match solo ``generate()`` (tested);
+chunk boundaries change fp contraction widths, so cached K/V can
+differ at ulp scale from the one-pass values — a high-temperature
+categorical draw at an exact tie may pick differently (equivalence is
+distributional there, not bitwise).
+
 Paged slots get PREFIX CACHING for free: a full page of prompt K/V is
 content-addressed (hash of the whole token prefix it depends on) and
 refcounted, so a request whose prompt starts with an already-resident
@@ -57,7 +68,11 @@ prefix — the shared-system-prompt workload — shares those pages (live
 or retired) and prefills only its suffix in one ``verify_chunk`` pass.
 Retired pages linger as an evict-under-pressure LRU. Hit/miss/cached
 counts surface in :meth:`stats`; outputs stay token-identical to solo
-``generate()`` (tested, including two live requests sharing pages).
+``generate()`` on every tested workload, including two live requests
+sharing pages and sampled streams — with the same fine print as
+chunked prefill: the suffix pass's contraction width differs from the
+one-pass prefill's, so a categorical draw at an exact fp tie could in
+principle diverge (greedy cannot, short of an exact argmax tie).
 
 ``top_k`` is per-REQUEST despite being shape-like (see
 ``_truncate_rows``); ticks with no truncating request skip the filter
@@ -109,6 +124,10 @@ class _Request:
 class _Slot:
     idx: int = -1  # position in the slot list (page-table row)
     req: _Request | None = None
+    #: chunked prefill progress: next position to prefill, or -1 when
+    #: not mid-prefill (the slot decodes). A slot with pf_done >= 0
+    #: holds its request but sits out the decode batch.
+    pf_done: int = -1
     s0: int = 0  # prompt length
     #: cache position where the next tick's CONSUMED token (last_token,
     #: stream index emitted-1) writes its K/V: s0 + emitted - 1.
@@ -139,6 +158,7 @@ class ContinuousBatcher:
         kv_layout: str = "slots",
         page_size: int = 128,
         pool_pages: int | None = None,
+        prefill_chunk: int | None = None,
     ):
         self.lm = lm
         self.variables = variables
@@ -170,6 +190,18 @@ class ContinuousBatcher:
         #: (``runtime/paged`` allocator, ``ops/paged_attention`` kernel)
         #: — HBM scales with resident tokens, not slots x max_len.
         self._paged = kv_layout == "paged"
+        if prefill_chunk is not None:
+            if not self._paged:
+                raise ValueError(
+                    "prefill_chunk requires kv_layout='paged' (chunk "
+                    "passes run over the page-strip machinery)"
+                )
+            if prefill_chunk < page_size or prefill_chunk % page_size:
+                raise ValueError(
+                    f"prefill_chunk must be a positive multiple of "
+                    f"page_size {page_size}, got {prefill_chunk}"
+                )
+        self._prefill_chunk = prefill_chunk
         if top_k is not None and not (1 <= top_k <= lm.vocab):
             raise ValueError(f"top_k {top_k} outside [1, {lm.vocab}]")
         if prompt_buckets is None:
@@ -384,18 +416,25 @@ class ContinuousBatcher:
         self._prefill_cache[bucket] = prefill
         return prefill
 
-    def _prefill_suffix_fn(self, sbucket: int, n_strip: int):
-        """Jitted SUFFIX prefill (paged prefix-cache hit): the first m
-        pages of the slot's window already hold shared prompt K/V; only
-        the suffix runs the forward. Per block: gather the working strip
-        from the pools, append the suffix in one ``verify_chunk`` pass
-        (each suffix row attends the strip up to its own position — the
+    def _prefill_suffix_fn(self, sbucket: int, n_strip: int,
+                           sample: bool = True):
+        """Jitted INCREMENTAL prefill pass over a paged window: positions
+        [pos0, pos0 + true_len) run the forward against everything
+        already cached before them. Per block: gather the working strip
+        from the pools, append the chunk in one ``verify_chunk`` pass
+        (each row attends the strip up to its own position — the
         speculative-verify primitive reused as incremental prefill),
-        scatter the NEW pages back (shared pages are immutable; their
-        strip copies land in the trash page). Specializes per
-        (suffix bucket, strip pages) — a stable system-prompt workload
-        sees a handful of variants."""
-        key = ("suffix", sbucket, n_strip)
+        scatter the NEW pages back (pages before pos0 are immutable —
+        shared prefix or earlier chunks; their strip copies land in the
+        trash page).
+
+        Two callers, one body: the prefix-cache hit (single pass,
+        ``sample=True``) and chunked prefill (every pass but the last
+        uses ``sample=False`` and returns a dummy token). Specializes
+        per (chunk bucket, strip pages, sample) — a stable
+        system-prompt / chunk-size workload sees a handful of
+        variants."""
+        key = ("suffix", sbucket, n_strip, sample)
         if key in self._prefill_cache:
             return self._prefill_cache[key]
         page = self._page
@@ -421,6 +460,8 @@ class ContinuousBatcher:
                 kp = scatter_strip_pages(kp, pages, sk, start_page)
                 vp = scatter_strip_pages(vp, pages, sv, start_page)
                 new_caches.append((kp, vp))
+            if not sample:  # mid-prefill pass: no token yet
+                return jnp.zeros((1,), jnp.int32), new_caches
             h_last = lax.dynamic_index_in_dim(h, true_len - 1, 1)
             first = self._first_pick(
                 h_last, variables, keys, temp, top_k, top_p, greedy,
@@ -549,6 +590,7 @@ class ContinuousBatcher:
         global_metrics().inc("continuous.completed")
         slot.req = None
         slot.tokens = []
+        slot.pf_done = -1
         if self._paged:
             # Pages return to the pool the moment the request retires —
             # the capacity win continuous paging exists for.
@@ -604,6 +646,25 @@ class ContinuousBatcher:
                     with self._cv:
                         self._queue.appendleft(req)
                     return
+            if (
+                self._paged
+                and self._prefill_chunk is not None
+                and s0 - m * self._page > self._prefill_chunk
+            ):
+                # Chunked prefill: park the slot in the prefilling state
+                # — tick() runs one chunk pass per tick alongside the
+                # decode batch, so this long admission never stalls the
+                # requests already decoding. The first token samples on
+                # the final chunk.
+                slot.req = req
+                slot.s0 = s0
+                slot.pos = s0
+                slot.emitted = 0
+                slot.tokens = []
+                slot.pf_done = m * self._page
+                self._admitted += 1
+                global_metrics().inc("continuous.admitted")
+                continue
             if m:
                 # Suffix-only prefill against the shared prefix pages.
                 # The suffix pads to whole PAGES, not prompt buckets —
@@ -676,35 +737,118 @@ class ContinuousBatcher:
             slot.pos = s0
             slot.emitted = 0
             slot.tokens = []
+            slot.pf_done = -1
             self._admitted += 1
             global_metrics().inc("continuous.admitted")
             self._commit(slot, int(first[0]))
 
+    def _prefill_step(self, slot: _Slot) -> None:
+        """One chunked-prefill pass for ``slot``: write positions
+        [pf_done, pf_done + clen) through the incremental-prefill body.
+        The final pass samples the first token and flips the slot into
+        the decode batch."""
+        req, s0, P = slot.req, slot.s0, self._page
+        pos0 = slot.pf_done  # page-aligned (chunks are page multiples)
+        clen = min(self._prefill_chunk, s0 - pos0)
+        final = pos0 + clen >= s0
+        cbucket = -(-clen // P) * P
+        n_strip = (pos0 + cbucket) // P
+        owned = self._pager.owned(slot.idx)
+        assert n_strip <= len(owned)
+        # Pad the strip to a power-of-two page count so a long prompt
+        # compiles log2 variants instead of one per chunk ordinal (pad
+        # pages gather the trash page; their positions sit past the
+        # chunk's causal window, masked). The gather itself still costs
+        # O(prefix) HBM per pass — quadratic over the whole prefill;
+        # acceptable next to the O(prefix) attention math each pass
+        # already does, and the known fix (a chunk-query paged kernel
+        # attending pages in place, per-row causal shift) is the next
+        # kernel on the list.
+        n_pad = 1
+        while n_pad < n_strip:
+            n_pad *= 2
+        pages = owned[:n_strip] + [0] * (n_pad - n_strip)
+        ids = np.zeros((1, cbucket), np.int32)
+        ids[0, :clen] = req.prompt[pos0:pos0 + clen]
+        first, self._caches = self._prefill_suffix_fn(
+            cbucket, n_pad, sample=final
+        )(
+            self.variables,
+            self._caches,
+            jnp.asarray(pages, jnp.int32),
+            jnp.asarray(ids),
+            jnp.asarray(pos0, jnp.int32),
+            jnp.asarray(clen, jnp.int32),
+            jnp.asarray(req.folded_keys[0][None]),
+            jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.top_k, jnp.int32),
+            jnp.asarray(req.top_p, jnp.float32),
+            jnp.asarray(req.temperature == 0.0),
+            # Only the final pass samples; mid-prefill passes must not
+            # fork compile variants over sampling flags they never use.
+            truncate=final and req.top_k < self.lm.vocab,
+            nucleus=final and req.top_p < 1.0,
+        )
+        slot.pf_done = pos0 + clen
+        if final:
+            for j in range(s0 // P):  # register() skips known keys
+                self._pager.register(
+                    owned[j], Pager.prefix_key(req.prompt, (j + 1) * P)
+                )
+            slot.pf_done = -1
+            self._commit(slot, int(first[0]))
+
     def tick(self) -> int:
-        """Admit waiting requests into free slots, then run ONE chunk of
-        lockstep decode steps (a single compiled scan + one host sync).
-        Returns the number of active slots that consumed the chunk
-        (0 = fully idle)."""
+        """Admit waiting requests into free slots, run ONE prefill chunk
+        for each slot mid-chunked-prefill, then ONE chunk of lockstep
+        decode steps (a single compiled scan + one host sync) for the
+        decoding slots. Returns the number of active slots that
+        consumed the decode chunk (0 = no decoding happened this
+        tick)."""
         self._admit()
-        active = [s for s in self.slots if s.req is not None]
+        for slot in self.slots:
+            if slot.req is not None and slot.pf_done >= 0:
+                self._prefill_step(slot)  # interleaves with decode below
+        active = [
+            s for s in self.slots
+            if s.req is not None and s.pf_done < 0
+        ]
         # Gauges refresh BEFORE the idle early-return, or an empty
         # batcher would scrape its last busy tick's values forever.
-        global_metrics().set_gauge("continuous.active_slots", len(active))
+        # active_slots means OCCUPANCY (request held), matching
+        # stats()["active"]; the prefilling subset gets its own gauge —
+        # a device busy with chunk passes must not scrape as idle.
+        global_metrics().set_gauge(
+            "continuous.active_slots",
+            sum(1 for s in self.slots if s.req is not None),
+        )
+        global_metrics().set_gauge(
+            "continuous.prefilling_slots",
+            sum(1 for s in self.slots
+                if s.req is not None and s.pf_done >= 0),
+        )
         global_metrics().set_gauge("continuous.queue_depth", len(self._queue))
         if not active:
             return 0
         B, C = len(self.slots), self.chunk
         tokens = np.zeros((B,), np.int32)
         # Idle rows: slot layout points at the trash POSITION; paged
-        # layout at position 0 of an all-trash-page table row.
-        pos = np.full((B,), 0 if self._paged else self._trash, np.int32)
+        # layout uses a negative sentinel that stays negative across
+        # the whole chunk's pos+1 increments (-(C+1) .. -2), routing
+        # every garbage write to the trash page — a mid-prefill slot
+        # owns REAL pages, so "position 0 of its table row" would be
+        # its prompt's first page (the corruption this sentinel
+        # prevents), and masking every position out of its attention.
+        pos = np.full(
+            (B,), -(C + 1) if self._paged else self._trash, np.int32
+        )
         keys = np.zeros((C, B, 2), np.uint32)
         temps = np.zeros((B,), np.float32)
         top_ks = np.full((B,), self.lm.vocab, np.int32)
         top_ps = np.ones((B,), np.float32)
         greedy = np.ones((B,), bool)
         for i, slot in enumerate(self.slots):
-            if slot.req is None:
+            if slot.req is None or slot.pf_done >= 0:
                 continue
             tokens[i] = slot.last_token
             pos[i] = slot.pos
@@ -737,7 +881,7 @@ class ContinuousBatcher:
         global_metrics().inc("continuous.ticks")
         toks = np.asarray(toks)  # (C, B) — the chunk's ONE host sync
         for i, slot in enumerate(self.slots):
-            if slot.req is None:
+            if slot.req is None or slot.pf_done >= 0:
                 continue
             req = slot.req
             for j in range(C):

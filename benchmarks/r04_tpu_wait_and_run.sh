@@ -1,0 +1,21 @@
+#!/bin/bash
+# Relay-outage babysitter: probe the TPU relay every ~8 min and fire the
+# given queue script the moment it answers. The probe is itself a JAX
+# process through the relay, so this must only run while NOTHING else
+# does (the serial rule). Gives up after MAX_TRIES probes.
+# Usage: bash benchmarks/r04_tpu_wait_and_run.sh benchmarks/r04_tpu_queue3.sh
+set -u
+cd "$(dirname "$0")/.."
+QUEUE="${1:?queue script}"
+MAX_TRIES="${2:-25}"
+for i in $(seq 1 "$MAX_TRIES"); do
+  echo "=== $(date +%H:%M:%S) probe $i/$MAX_TRIES"
+  if timeout 120 python -c "import jax; print(jax.devices())"; then
+    echo "=== $(date +%H:%M:%S) relay up -> running $QUEUE"
+    bash "$QUEUE"
+    exit $?
+  fi
+  sleep 480
+done
+echo "=== $(date +%H:%M:%S) relay never came back after $MAX_TRIES probes"
+exit 1

@@ -113,6 +113,15 @@ def lm_setup_64():
     return lm, variables
 
 
+@pytest.fixture(scope="module")
+def lm_setup_256():
+    lm = lm_tiny(vocab=37, max_len=256)
+    variables = lm.graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )
+    return lm, variables
+
+
 def _solo(lm, variables, prompt, steps, **kw):
     return np.asarray(
         generate(lm, variables, jnp.asarray(prompt)[None], steps, **kw)
@@ -290,6 +299,110 @@ def test_prefix_hit_suffix_bucket_rounds_past_span(lm_setup_64):
     np.testing.assert_array_equal(
         out2[r2], _solo(lm, variables, second, 5)
     )
+
+
+def test_chunked_prefill_matches_generate_and_interleaves(lm_setup_64):
+    """A long prompt admitted with prefill_chunk=16 prefills one
+    page-chunk per tick while an already-running request keeps
+    decoding — the long admission must not stall it — and the chunked
+    request's GREEDY output equals solo generate(). (Greedy is the
+    contract: chunk boundaries change fp contraction widths, so the
+    cached K/V can differ from the one-pass values at ulp scale —
+    invisible to argmax, but able to flip a high-temperature
+    categorical draw at a near-tie. The sampled stream's equivalence
+    is distributional, not bitwise — documented on prefill_chunk.)"""
+    lm, variables = lm_setup_64
+    rng = np.random.RandomState(12)
+    short = rng.randint(0, 37, size=4).astype(np.int32)
+    long_p = rng.randint(0, 37, size=50).astype(np.int32)
+    bat = ContinuousBatcher(
+        lm, variables, slots=2, chunk=2, kv_layout="paged", page_size=16,
+        prefill_chunk=16,
+    )
+    r_short = bat.submit(short, 8,
+                         temperature=0.9, top_k=5,
+                         rng=jax.random.PRNGKey(13))
+    bat.tick()  # short decoding
+    emitted_before = len(bat.slots[0].tokens)
+    r_long = bat.submit(long_p, 4)
+    bat.tick()  # long prefills its first chunk; short keeps decoding
+    assert bat.slots[1].pf_done >= 0  # still mid-prefill
+    assert len(bat.slots[0].tokens) > emitted_before  # no stall
+    out = bat.run()
+    np.testing.assert_array_equal(
+        out[r_short],
+        _solo(lm, variables, short, 8, temperature=0.9, top_k=5,
+              rng=jax.random.PRNGKey(13)),
+    )
+    np.testing.assert_array_equal(
+        out[r_long], _solo(lm, variables, long_p, 4)
+    )
+
+
+def test_chunked_prefill_composes_with_prefix_cache(lm_setup_64):
+    """Chunked prefill starts AFTER the shared prefix: a second long
+    request with a cached 32-token prefix prefills only its remaining
+    pages chunk by chunk, and matches solo generate()."""
+    lm, variables = lm_setup_64
+    rng = np.random.RandomState(13)
+    system = rng.randint(0, 37, size=32).astype(np.int32)
+    p1 = np.concatenate([system, rng.randint(0, 37, size=18).astype(np.int32)])
+    p2 = np.concatenate([system, rng.randint(0, 37, size=20).astype(np.int32)])
+    bat = ContinuousBatcher(
+        lm, variables, slots=1, chunk=2, kv_layout="paged", page_size=16,
+        prefill_chunk=16,
+    )
+    r1 = bat.submit(p1, 4)
+    out1 = bat.run()
+    hits_before = bat._pager.prefix_hits
+    r2 = bat.submit(p2, 4)
+    bat.tick()
+    # p2 shares the two system pages and chunk-prefills from there.
+    assert bat._pager.prefix_hits == hits_before + 2
+    out2 = bat.run()
+    np.testing.assert_array_equal(out1[r1], _solo(lm, variables, p1, 4))
+    np.testing.assert_array_equal(out2[r2], _solo(lm, variables, p2, 4))
+
+
+def test_decode_during_chunked_prefill_cannot_corrupt_prompt_pages(
+    lm_setup_256,
+):
+    """Regression: while a slot is mid-chunked-prefill it still rides
+    the lockstep decode batch as a dead row — and a dead row OWNS real
+    pages, so its garbage write must go to the trash page, not
+    table[row, 0] (= the prompt's first page). Before the negative-pos
+    sentinel, concurrent decode overwrote prompt positions 0..chunk-1
+    every tick and the chunked request's stream diverged from token
+    one."""
+    lm, variables = lm_setup_256
+    rng = np.random.RandomState(14)
+    short = rng.randint(0, 37, size=5).astype(np.int32)
+    long_p = rng.randint(0, 37, size=124).astype(np.int32)
+    bat = ContinuousBatcher(
+        lm, variables, slots=2, chunk=2, kv_layout="paged", page_size=16,
+        prefill_chunk=32,
+    )
+    r_short = bat.submit(short, 40)  # still decoding through the prefill
+    bat.tick()
+    r_long = bat.submit(long_p, 5)
+    bat.tick()
+    assert bat.slots[1].pf_done >= 0  # mid-prefill with decode running
+    out = bat.run()
+    np.testing.assert_array_equal(
+        out[r_short], _solo(lm, variables, short, 40)
+    )
+    np.testing.assert_array_equal(
+        out[r_long], _solo(lm, variables, long_p, 5)
+    )
+
+
+def test_chunked_prefill_validation(lm_setup):
+    lm, variables = lm_setup
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatcher(lm, variables, prefill_chunk=16)
+    with pytest.raises(ValueError, match="multiple"):
+        ContinuousBatcher(lm, variables, kv_layout="paged", page_size=16,
+                          prefill_chunk=24)
 
 
 def test_paged_validation(lm_setup):
