@@ -35,7 +35,10 @@ from jax import lax
 from adapt_tpu.graph.ir import INPUT, LayerGraph
 from adapt_tpu.ops.attention import flash_attention
 from adapt_tpu.ops.decode_attention import decode_attention
-from adapt_tpu.ops.paged_attention import paged_attention
+from adapt_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_chunk_attention,
+)
 from adapt_tpu.ops.quantize import quantize_kv_vectors
 
 _NEG_INF = -1e30
@@ -298,6 +301,38 @@ class CausalSelfAttention(nn.Module):
         o = jnp.swapaxes(o, 1, 2).reshape(b, 1, self.dim)
         return self.out(o), k_pool, v_pool
 
+    def prefill_chunk_paged(
+        self, x, k_pool, v_pool, pages, pos0, attn_impl=None,
+    ):
+        """Incremental prefill of a CHUNK of positions [pos0, pos0 + C)
+        directly against a paged window: write the chunk's K/V into its
+        own pages (one O(C) scatter), then attend the whole window in
+        place via :func:`paged_chunk_attention` — no gathered strip, no
+        scatter-back (the chunked-prefill counterpart of
+        ``decode_step_paged``). ``pages`` (n,) covers [0, pos0 + C)
+        (pow2 trash padding allowed); ``pos0`` is page-aligned and C is
+        a whole number of pages. Batch 1 (prefill is per request)."""
+        b, c, d = x.shape
+        page = k_pool.shape[2]
+        q, k, v = self._project(x)  # q (1, h, C, hd); k/v (1, kv_h, C, hd)
+        q = self._group_q(q)  # (1, kv_h, g*C, hd)
+        n_chunk = c // page
+        chunk_pages = lax.dynamic_slice(
+            jnp.asarray(pages, jnp.int32), (pos0 // page,), (n_chunk,)
+        )
+        kvh, hd = k.shape[1], k.shape[3]
+        to_pages = lambda t: jnp.swapaxes(
+            t[0].reshape(kvh, n_chunk, page, hd), 0, 1
+        )
+        k_pool = k_pool.at[chunk_pages].set(to_pages(k).astype(k_pool.dtype))
+        v_pool = v_pool.at[chunk_pages].set(to_pages(v).astype(v_pool.dtype))
+        o = paged_chunk_attention(
+            q, k_pool, v_pool, pages, pos0, c, prefer=attn_impl
+        ).astype(x.dtype)
+        o = self._ungroup_o(o, c)  # (1, h, C, hd)
+        o = jnp.swapaxes(o, 1, 2).reshape(b, c, self.dim)
+        return self.out(o), k_pool, v_pool
+
     def verify_chunk(self, x, cache_k, cache_v, index):
         """Append a CHUNK of ``K`` tokens at positions
         ``index..index+K-1`` in ONE cached pass — the speculative-decode
@@ -400,6 +435,15 @@ class DecoderBlock(nn.Module):
         )
         x_t = x_t + a
         return x_t + self._mlp(self.ln2(x_t)), kp, vp
+
+    def prefill_chunk_paged(
+        self, x, k_pool, v_pool, pages, pos0, attn_impl=None,
+    ):
+        a, kp, vp = self.attn.prefill_chunk_paged(
+            self.ln1(x), k_pool, v_pool, pages, pos0, attn_impl
+        )
+        x = x + a
+        return x + self._mlp(self.ln2(x)), kp, vp
 
     def verify_chunk(self, x, cache_k, cache_v, index):
         a, ck, cv = self.attn.verify_chunk(

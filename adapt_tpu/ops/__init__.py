@@ -13,6 +13,8 @@ from adapt_tpu.ops.decode_attention import (
 from adapt_tpu.ops.paged_attention import (
     paged_attention,
     paged_attention_reference,
+    paged_chunk_attention,
+    paged_chunk_attention_reference,
 )
 
 __all__ = [
@@ -25,6 +27,8 @@ __all__ = [
     "flash_attention",
     "paged_attention",
     "paged_attention_reference",
+    "paged_chunk_attention",
+    "paged_chunk_attention_reference",
     "quantize",
     "quantize_reference",
 ]

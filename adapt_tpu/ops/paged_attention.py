@@ -188,6 +188,189 @@ def _paged_kernel(table_ref, q_ref, k_ref, v_ref, idx_ref, *refs, block_k,
     )
 
 
+def _chunk_kernel(pages_ref, q_ref, k_ref, v_ref, pos0_ref, *refs,
+                  block_k, num_kv, sm_scale, chunk):
+    """Chunk-query paged attention: q rows are a CHUNK of positions
+    [pos0, pos0 + chunk) (GQA groups folded in, row = member*chunk + p)
+    attending the paged window up to each row's own position — the
+    per-row causal mask ``col <= pos0 + row % chunk``. One (kv_head)
+    program streams the window's pages innermost with online-softmax
+    scratch, exactly the decode kernel's discipline with a row-dependent
+    diagonal instead of a shared index."""
+    del pages_ref  # consumed by the index_maps
+    o_ref, m_scr, l_scr, acc_scr = refs
+    j = pl.program_id(1)
+    gc = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, -1e30, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32)  # (gc, hd)
+        k = k_ref[0, 0].astype(jnp.float32)  # (block_k, hd)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * sm_scale
+        )  # (gc, block_k)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (gc, block_k), 0) % chunk
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (gc, block_k), 1
+        )
+        live = cols <= pos0_ref[0] + rows
+        s = jnp.where(live, s, -1e30)
+        m = m_scr[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    # Pages entirely past the chunk's last position are dead (the pow2
+    # padding's trash pages land here too).
+    pl.when(j * block_k <= pos0_ref[0] + chunk - 1)(_step)
+
+    @pl.when(j == num_kv - 1)
+    def _emit():
+        o_ref[0] = (
+            acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def paged_chunk_attention_reference(q, k_pool, v_pool, pages, pos0,
+                                    chunk: int):
+    """jnp oracle for the chunk-query kernel: gather the window, mask
+    ``col <= pos0 + row % chunk``, softmax, weight. q is (1, kv_h, g*C,
+    hd) GROUP-FOLDED (row = member*C + position), pages (n,)."""
+    kvh, hd = k_pool.shape[1], k_pool.shape[3]
+    gather = lambda pool: jnp.moveaxis(pool[pages], 1, 0).reshape(
+        1, kvh, -1, hd
+    )
+    k, v = gather(k_pool), gather(v_pool)
+    sm = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * sm
+    rows = jnp.arange(q.shape[2]) % chunk
+    cols = jnp.arange(k.shape[2])
+    live = cols[None, :] <= pos0 + rows[:, None]
+    s = jnp.where(live[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+    ).astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def _chunk_impl(q, k_pool, v_pool, pages, pos0, chunk):
+    _, kvh, gc, hd = q.shape
+    page = k_pool.shape[2]
+    n = pages.shape[0]
+    pad_g = (-gc) % 8
+    if pad_g:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_g), (0, 0)))
+    gcp = gc + pad_g
+    qf = q.reshape(kvh, gcp, hd)
+    pos0v = jnp.reshape(jnp.asarray(pos0, jnp.int32), (1,))
+
+    def q_map(h, j, pages_ref):
+        del j, pages_ref
+        return (h, 0, 0)
+
+    def kv_map(h, j, pages_ref):
+        return (pages_ref[j], h, 0, 0)
+
+    def smem_map(h, j, pages_ref):
+        del h, j, pages_ref
+        return (0,)
+
+    on_tpu = jax.default_backend() == "tpu"
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(kvh, n),
+        in_specs=[
+            pl.BlockSpec((1, gcp, hd), q_map, memory_space=_VMEM),
+            pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
+            pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
+            pl.BlockSpec((1,), smem_map, memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, gcp, hd), q_map, memory_space=_VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((gcp, 1), jnp.float32),
+            pltpu.VMEM((gcp, 1), jnp.float32),
+            pltpu.VMEM((gcp, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _chunk_kernel,
+            block_k=page,
+            num_kv=n,
+            sm_scale=1.0 / (hd ** 0.5),
+            chunk=chunk,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((kvh, gcp, hd), q.dtype),
+        compiler_params=(
+            pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")
+            )
+            if on_tpu
+            else None
+        ),
+        interpret=not on_tpu,
+    )(jnp.asarray(pages, jnp.int32), qf, k_pool, v_pool, pos0v)
+    return out.reshape(1, kvh, gcp, hd)[:, :, :gc, :]
+
+
+def paged_chunk_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    pages: jax.Array,
+    pos0,
+    chunk: int,
+    prefer: str | None = None,
+) -> jax.Array:
+    """Chunk-prefill attention over a paged window, in place — the
+    incremental-prefill counterpart of :func:`paged_attention` (no
+    gathered strip, no scatter-back; the caller writes the chunk's K/V
+    pages first, this reads the window page by page).
+
+    q (1, kv_h, g*chunk, hd) group-folded; ``pages`` (n,) covers the
+    whole live window [0, pos0 + chunk) (pow2 padding to the trash page
+    is fine — those positions are past every row's mask). Dispatch as
+    :func:`paged_attention`: kernel on real TPUs with lane-multiple
+    pages, oracle elsewhere."""
+    page = k_pool.shape[2]
+    supported = pltpu is not None and page % 128 == 0
+    if prefer is None:
+        prefer = (
+            "pallas"
+            if supported and jax.default_backend() == "tpu"
+            else "xla"
+        )
+    elif prefer not in ("pallas", "xla"):
+        raise ValueError(
+            f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
+        )
+    if prefer == "pallas" and supported:
+        return _chunk_impl(q, k_pool, v_pool, pages, pos0, chunk)
+    return paged_chunk_attention_reference(
+        q, k_pool, v_pool, pages, pos0, chunk
+    )
+
+
 def paged_attention(
     q: jax.Array,
     k_pool: jax.Array,

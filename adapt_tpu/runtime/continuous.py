@@ -96,12 +96,7 @@ import numpy as np
 from jax import lax
 
 from adapt_tpu.models.transformer_lm import TransformerLM, nucleus_filter
-from adapt_tpu.runtime.paged import (
-    Pager,
-    gather_pages as _gather_pages,
-    insert_prefill_pages,
-    scatter_strip_pages,
-)
+from adapt_tpu.runtime.paged import Pager, insert_prefill_pages
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
 
@@ -420,24 +415,22 @@ class ContinuousBatcher:
                            sample: bool = True):
         """Jitted INCREMENTAL prefill pass over a paged window: positions
         [pos0, pos0 + true_len) run the forward against everything
-        already cached before them. Per block: gather the working strip
-        from the pools, append the chunk in one ``verify_chunk`` pass
-        (each row attends the strip up to its own position — the
-        speculative-verify primitive reused as incremental prefill),
-        scatter the NEW pages back (pages before pos0 are immutable —
-        shared prefix or earlier chunks; their strip copies land in the
-        trash page).
+        already cached before them, IN PLACE — each block writes the
+        chunk's K/V into its own pages (one O(chunk) scatter) and
+        attends the window page by page
+        (``models.prefill_chunk_paged`` -> ``paged_chunk_attention``,
+        per-row causal mask). No gathered strip, no scatter-back: pass
+        traffic is O(window) reads + O(chunk) writes.
 
         Two callers, one body: the prefix-cache hit (single pass,
         ``sample=True``) and chunked prefill (every pass but the last
         uses ``sample=False`` and returns a dummy token). Specializes
-        per (chunk bucket, strip pages, sample) — a stable
-        system-prompt / chunk-size workload sees a handful of
+        per (chunk bucket, window pages, sample) — chunked callers pad
+        the page list to powers of two, so a long prompt compiles log2
         variants."""
         key = ("suffix", sbucket, n_strip, sample)
         if key in self._prefill_cache:
             return self._prefill_cache[key]
-        page = self._page
 
         @partial(jax.jit, static_argnames=("truncate", "nucleus"),
                  donate_argnums=(1,))
@@ -447,18 +440,14 @@ class ContinuousBatcher:
             h = self._embed.apply(
                 variables["embed"], ids, pos_ids, method="embed_positions"
             )
-            start_page = pos0 // page
             new_caches = []
             for name, block, (kp, vp) in zip(
                 self.lm.block_names, self._blocks, caches
             ):
-                sk = _gather_pages(kp, pages)
-                sv = _gather_pages(vp, pages)
-                h, sk, sv = block.apply(
-                    variables[name], h, sk, sv, pos0, method="verify_chunk"
+                h, kp, vp = block.apply(
+                    variables[name], h, kp, vp, pages, pos0,
+                    method="prefill_chunk_paged",
                 )
-                kp = scatter_strip_pages(kp, pages, sk, start_page)
-                vp = scatter_strip_pages(vp, pages, sv, start_page)
                 new_caches.append((kp, vp))
             if not sample:  # mid-prefill pass: no token yet
                 return jnp.zeros((1,), jnp.int32), new_caches
@@ -646,26 +635,20 @@ class ContinuousBatcher:
                     with self._cv:
                         self._queue.appendleft(req)
                     return
-            if (
+            chunked = (
                 self._paged
                 and self._prefill_chunk is not None
                 and s0 - m * self._page > self._prefill_chunk
-            ):
+            )
+            first = None
+            if chunked:
                 # Chunked prefill: park the slot in the prefilling state
                 # — tick() runs one chunk pass per tick alongside the
                 # decode batch, so this long admission never stalls the
                 # requests already decoding. The first token samples on
-                # the final chunk.
-                slot.req = req
-                slot.s0 = s0
-                slot.pos = s0
-                slot.emitted = 0
-                slot.tokens = []
-                slot.pf_done = m * self._page
-                self._admitted += 1
-                global_metrics().inc("continuous.admitted")
-                continue
-            if m:
+                # the final chunk (no _commit here).
+                pass
+            elif m:
                 # Suffix-only prefill against the shared prefix pages.
                 # The suffix pads to whole PAGES, not prompt buckets —
                 # page rounding keeps the strip inside the reserved
@@ -723,10 +706,11 @@ class ContinuousBatcher:
                     self._caches = self._insert(
                         self._caches, jnp.asarray(i, jnp.int32), kvs
                     )
-            if self._paged:
+            if self._paged and not chunked:
                 # Publish this request's full prompt pages for future
                 # sharing (first writer wins; the shared ones are
-                # already registered).
+                # already registered). Chunked admissions register on
+                # their final pass instead.
                 owned = self._pager.owned(i)
                 for j in range(m, s0 // self._page):
                     self._pager.register(
@@ -737,10 +721,11 @@ class ContinuousBatcher:
             slot.pos = s0
             slot.emitted = 0
             slot.tokens = []
-            slot.pf_done = -1
+            slot.pf_done = m * self._page if chunked else -1
             self._admitted += 1
             global_metrics().inc("continuous.admitted")
-            self._commit(slot, int(first[0]))
+            if not chunked:
+                self._commit(slot, int(first[0]))
 
     def _prefill_step(self, slot: _Slot) -> None:
         """One chunked-prefill pass for ``slot``: write positions
@@ -755,15 +740,10 @@ class ContinuousBatcher:
         n_strip = (pos0 + cbucket) // P
         owned = self._pager.owned(slot.idx)
         assert n_strip <= len(owned)
-        # Pad the strip to a power-of-two page count so a long prompt
+        # Pad the window to a power-of-two page count so a long prompt
         # compiles log2 variants instead of one per chunk ordinal (pad
-        # pages gather the trash page; their positions sit past the
-        # chunk's causal window, masked). The gather itself still costs
-        # O(prefix) HBM per pass — quadratic over the whole prefill;
-        # acceptable next to the O(prefix) attention math each pass
-        # already does, and the known fix (a chunk-query paged kernel
-        # attending pages in place, per-row causal shift) is the next
-        # kernel on the list.
+        # entries point at the trash page; their positions sit past the
+        # chunk's causal window, masked and compute-skipped).
         n_pad = 1
         while n_pad < n_strip:
             n_pad *= 2

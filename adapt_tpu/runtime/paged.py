@@ -195,27 +195,4 @@ def insert_prefill_pages(pool, pages, kv):
     return pool.at[pages].set(kvp.astype(pool.dtype))
 
 
-@jax.jit
-def gather_pages(pool, pages):
-    """(n,) physical pages -> one contiguous (1, kv_h, n*P, hd) working
-    strip (the suffix-prefill staging form; decode never gathers — the
-    kernel streams pages in place)."""
-    g = pool[pages]  # (n, kvh, P, hd)
-    _, kvh, page, hd = pool.shape
-    return jnp.moveaxis(g, 1, 0).reshape(1, kvh, -1, hd)
 
-
-@partial(jax.jit, donate_argnums=(0,))
-def scatter_strip_pages(pool, pages, strip, start_page: jax.Array):
-    """Write a contiguous (1, kv_h, W, hd) working strip's pages back
-    into the pool, SKIPPING the first ``start_page`` logical pages
-    (shared prefix pages are immutable — only the suffix's pages land).
-    ``pages`` is the full (n,) logical->physical map; skipped entries
-    scatter into the trash page instead of their (shared) target."""
-    n = pages.shape[0]
-    _, kvh, page, hd = pool.shape
-    w = strip.shape[2]
-    sp = jnp.pad(strip[0], ((0, 0), (0, n * page - w), (0, 0)))
-    sp = jnp.swapaxes(sp.reshape(kvh, n, page, hd), 0, 1)  # (n,kvh,P,hd)
-    dest = jnp.where(jnp.arange(n) < start_page, 0, pages)
-    return pool.at[dest].set(sp.astype(pool.dtype))

@@ -99,6 +99,27 @@ def _child() -> None:
     cases.append({"case": "paged_mha_8pages", "max_err": err,
                   "ok": err < 2e-3})
 
+    # Chunk-query paged kernel (incremental prefill's per-row causal).
+    from adapt_tpu.ops.paged_attention import (
+        paged_chunk_attention,
+        paged_chunk_attention_reference,
+    )
+
+    kq2 = jax.random.fold_in(rng, 99)
+    chunkq = jax.random.normal(kq2, (1, kvh, 2 * 256, hd), jnp.float32)
+    cpages = jnp.asarray([5, 9, 2, 11, 0, 0, 0, 0], jnp.int32)
+    cref = np.asarray(
+        paged_chunk_attention_reference(chunkq, kp, vp, cpages, 256, 256)
+    )
+    cout = np.asarray(
+        paged_chunk_attention(
+            chunkq, kp, vp, cpages, 256, 256, prefer="pallas"
+        )
+    )
+    cerr = float(np.max(np.abs(cout - cref)))
+    cases.append({"case": "paged_chunk_gqa2_pos256", "max_err": cerr,
+                  "ok": cerr < 2e-3})
+
     ok = all(c["ok"] for c in cases)
     print(
         json.dumps(

@@ -66,6 +66,35 @@ def test_paged_kernel_matches_oracle(rng):
         )
 
 
+def test_paged_chunk_kernel_matches_oracle(rng):
+    """Chunk-query kernel (per-row causal over a paged window) vs its
+    gather oracle: GQA folding, non-zero pos0, and pow2 trash padding."""
+    from adapt_tpu.ops.paged_attention import (
+        paged_chunk_attention,
+        paged_chunk_attention_reference,
+    )
+
+    kvh, g, chunk, hd, page, npages = 2, 3, 32, 64, 128, 12
+    q = jax.random.normal(rng, (1, kvh, g * chunk, hd))
+    kp = jax.random.normal(
+        jax.random.fold_in(rng, 1), (npages, kvh, page, hd)
+    )
+    vp = jax.random.normal(
+        jax.random.fold_in(rng, 2), (npages, kvh, page, hd)
+    )
+    for pos0, pages in [(128, [3, 7, 0, 0]), (0, [5, 0]),
+                        (256, [2, 4, 9, 0])]:
+        pages = jnp.asarray(pages, jnp.int32)
+        ref = paged_chunk_attention_reference(q, kp, vp, pages, pos0, chunk)
+        out = paged_chunk_attention(
+            q, kp, vp, pages, pos0, chunk, prefer="pallas"
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg=f"pos0={pos0}",
+        )
+
+
 def test_paged_kernel_unsupported_page_size_falls_back(rng):
     # page 16 is not a lane multiple: prefer="pallas" serves the oracle.
     b, kvh, g, hd, page, npages = 1, 2, 1, 64, 16, 8
