@@ -71,6 +71,93 @@ def _one_hot_routing(gates: jax.Array, capacity: int, top_k: int):
     return dispatch, combine, aux
 
 
+class MoEDecoderMlp(nn.Module):
+    """Dropless per-token MoE for the DECODE/serving paths: each token's
+    output is ``sum_{e in its top-k} gate_e * MLP_e(token)`` — no
+    capacity, no slots, no cross-token coupling. That independence is
+    the point: a token's output is a pure function of its own hidden
+    state, so KV-cached decode, verify_chunk, chunked prefill and the
+    full-sequence forward all agree EXACTLY (the repo's decode-parity
+    contract), where :class:`MoEMlp`'s capacity routing would drop
+    different tokens under different batch shapes.
+
+    Computed in the masked-dense form (every expert evaluates every
+    token via expert-stacked einsums; combine weights zero the rest) —
+    fully static shapes, no gather/scatter. With the expert dim sharded
+    over ``ep`` (:func:`adapt_tpu.parallel.expert.expert_shardings`
+    applies unchanged — same leading-``E`` params), GSPMD gives each
+    device its ``E/ep`` experts over replicated tokens and psums the
+    combine: per-device cost ~ ``(E/ep) x`` a dense MLP, the classic
+    dense-EP inference schedule. The capacity-routed :class:`MoEMlp`
+    remains the train-side layer (its dispatch einsums all-to-all
+    instead of replicating token compute)."""
+
+    num_experts: int = 8
+    hidden_dim: int = 128
+    top_k: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if self.top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 or 2, got {self.top_k}")
+        if self.top_k > self.num_experts:
+            # A third pick over a fully-masked gate row would re-select
+            # expert 0 and silently double its weight.
+            raise ValueError(
+                f"top_k {self.top_k} exceeds num_experts "
+                f"{self.num_experts}"
+            )
+        b, s, d = x.shape
+        e = self.num_experts
+        tokens = x.reshape(b * s, d)
+        wg = self.param(
+            "gate", nn.initializers.lecun_normal(), (d, e), jnp.float32
+        )
+        w1 = self.param(
+            "w1", nn.initializers.lecun_normal(),
+            (e, d, self.hidden_dim), jnp.float32,
+        )
+        b1 = self.param("b1", nn.initializers.zeros, (e, self.hidden_dim))
+        w2 = self.param(
+            "w2", nn.initializers.lecun_normal(),
+            (e, self.hidden_dim, d), jnp.float32,
+        )
+        b2 = self.param("b2", nn.initializers.zeros, (e, d))
+
+        gates = jax.nn.softmax(
+            tokens.astype(jnp.float32) @ wg, axis=-1
+        )  # [N, E]
+        combine = jnp.zeros_like(gates)
+        remaining = gates
+        for _ in range(self.top_k):
+            idx = jnp.argmax(remaining, axis=-1)
+            onehot = jax.nn.one_hot(idx, e, dtype=gates.dtype)
+            combine = combine + onehot * gates
+            remaining = remaining * (1.0 - onehot)
+        self.sow(
+            "intermediates", "aux_loss",
+            jnp.sum(
+                (jnp.sum(combine > 0, axis=0) / combine.shape[0])
+                * jnp.mean(gates, axis=0)
+            ) * e,
+        )
+
+        xt = tokens.astype(self.dtype)
+        h = jax.nn.gelu(
+            jnp.einsum("nd,edh->neh", xt, w1.astype(self.dtype))
+            + b1[None, :, :].astype(self.dtype)
+        )
+        out_e = (
+            jnp.einsum("neh,ehd->ned", h, w2.astype(self.dtype))
+            + b2[None, :, :].astype(self.dtype)
+        )
+        out = jnp.einsum(
+            "ned,ne->nd", out_e, combine.astype(self.dtype)
+        )
+        return out.reshape(b, s, d).astype(x.dtype)
+
+
 class MoEMlp(nn.Module):
     """Token-routed expert MLP: [B, S, D] -> [B, S, D]."""
 

@@ -39,6 +39,7 @@ from adapt_tpu.ops.paged_attention import (
     paged_attention,
     paged_chunk_attention,
 )
+from adapt_tpu.models.moe import MoEDecoderMlp
 from adapt_tpu.ops.quantize import quantize_kv_vectors
 
 _NEG_INF = -1e30
@@ -374,13 +375,24 @@ class CausalSelfAttention(nn.Module):
 class DecoderBlock(nn.Module):
     """Pre-LN decoder block; residuals stay inside the node so block
     boundaries are clean pipeline cuts (same contract as ViT's
-    ``EncoderBlock``)."""
+    ``EncoderBlock``).
+
+    ``moe_experts`` swaps the dense MLP for a dropless per-token MoE
+    (:class:`adapt_tpu.models.moe.MoEDecoderMlp`) — the Mixtral-shaped
+    decoder. ``_mlp`` is the ONE touch point every schedule shares
+    (full forward, prefill, decode_step, verify_chunk, paged chunk
+    prefill), so the MoE block serves through every decode path —
+    generate, continuous batching, speculative, pipelined — with the
+    exact cache-parity contract of the dense block, and its
+    expert-stacked params EP-shard via ``parallel.expert`` unchanged."""
 
     dim: int
     heads: int
     mlp_dim: int
     dtype: jnp.dtype = jnp.float32
     kv_heads: int | None = None
+    moe_experts: int | None = None
+    moe_top_k: int = 1
 
     @property
     def cache_heads(self) -> int:
@@ -397,10 +409,20 @@ class DecoderBlock(nn.Module):
             self.dim, self.heads, dtype=self.dtype, kv_heads=self.kv_heads
         )
         self.ln2 = nn.LayerNorm(dtype=self.dtype)
-        self.mlp_in = nn.Dense(self.mlp_dim, dtype=self.dtype)
-        self.mlp_out = nn.Dense(self.dim, dtype=self.dtype)
+        if self.moe_experts is not None:
+            self.moe = MoEDecoderMlp(
+                num_experts=self.moe_experts,
+                hidden_dim=self.mlp_dim,
+                top_k=self.moe_top_k,
+                dtype=self.dtype,
+            )
+        else:
+            self.mlp_in = nn.Dense(self.mlp_dim, dtype=self.dtype)
+            self.mlp_out = nn.Dense(self.dim, dtype=self.dtype)
 
     def _mlp(self, x):
+        if self.moe_experts is not None:
+            return self.moe(x)
         return self.mlp_out(nn.gelu(self.mlp_in(x)))
 
     def __call__(self, x):
@@ -532,10 +554,20 @@ def transformer_lm(
     dtype: jnp.dtype = jnp.float32,
     name: str = "transformer_lm",
     kv_heads: int | None = None,
+    moe_experts: int | None = None,
+    moe_top_k: int = 1,
 ) -> TransformerLM:
     """``kv_heads < heads`` builds a grouped-query (GQA) decoder: KV
     caches shrink by ``heads // kv_heads`` (``kv_heads=1`` = MQA), the
-    serving-era cache-capacity knob — see ``CausalSelfAttention``."""
+    serving-era cache-capacity knob — see ``CausalSelfAttention``.
+
+    ``moe_experts`` builds a Mixtral-shaped MoE decoder: every block's
+    MLP becomes a dropless per-token mixture of that many experts
+    (``moe_top_k`` active per token, ``mlp_dim`` = per-expert hidden).
+    Served by every decode path with exact cache parity, and
+    EP-shardable via ``parallel.expert.place_experts`` — see
+    :class:`DecoderBlock` / :class:`adapt_tpu.models.moe.MoEDecoderMlp`.
+    """
     g = LayerGraph(name)
     prev = g.add(
         "embed", TokenEmbed(vocab, dim, max_len, dtype=dtype), INPUT
@@ -544,7 +576,8 @@ def transformer_lm(
         prev = g.add(
             f"decoder_block_{i}",
             DecoderBlock(dim, heads, mlp_dim, dtype=dtype,
-                         kv_heads=kv_heads),
+                         kv_heads=kv_heads, moe_experts=moe_experts,
+                         moe_top_k=moe_top_k),
             prev,
         )
     g.add("head", LMHead(vocab, dtype=dtype), prev)

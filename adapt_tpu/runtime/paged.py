@@ -193,6 +193,3 @@ def insert_prefill_pages(pool, pages, kv):
     kvp = jnp.pad(kv[0], ((0, 0), (0, n * page - s), (0, 0)))
     kvp = jnp.swapaxes(kvp.reshape(kvh, n, page, hd), 0, 1)
     return pool.at[pages].set(kvp.astype(pool.dtype))
-
-
-
