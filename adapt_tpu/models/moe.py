@@ -30,6 +30,50 @@ import jax
 import jax.numpy as jnp
 
 
+def _expert_params(mod: nn.Module, d: int, e: int, hidden: int):
+    """The expert-stacked parameter block shared by the train-side
+    (:class:`MoEMlp`) and serve-side (:class:`MoEDecoderMlp`) layers —
+    one declaration, so their weights stay structurally interchangeable
+    (same names, shapes, initializers; ``parallel.expert`` shards both
+    identically)."""
+    wg = mod.param("gate", nn.initializers.lecun_normal(), (d, e),
+                   jnp.float32)
+    w1 = mod.param("w1", nn.initializers.lecun_normal(), (e, d, hidden),
+                   jnp.float32)
+    b1 = mod.param("b1", nn.initializers.zeros, (e, hidden))
+    w2 = mod.param("w2", nn.initializers.lecun_normal(), (e, hidden, d),
+                   jnp.float32)
+    b2 = mod.param("b2", nn.initializers.zeros, (e, d))
+    return wg, w1, b1, w2, b2
+
+
+def _topk_combine(gates: jax.Array, top_k: int):
+    """Per-token top-k gate weights [N, E] (chosen entries carry their
+    gate probability, the rest zero) plus the FIRST-choice one-hot —
+    the argmax-and-mask loop shared by both routing flavors."""
+    combine = jnp.zeros_like(gates)
+    first_onehot = None
+    remaining = gates
+    for choice in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)
+        onehot = jax.nn.one_hot(idx, gates.shape[-1], dtype=gates.dtype)
+        if choice == 0:
+            first_onehot = onehot
+        combine = combine + onehot * gates
+        remaining = remaining * (1.0 - onehot)
+    return combine, first_onehot
+
+
+def _switch_aux_loss(gates: jax.Array, first_onehot: jax.Array):
+    """THE load-balance aux convention (Switch-style, first choice
+    only, minimum 1.0 at perfect balance) — one definition so the two
+    MoE layers' sown ``aux_loss`` stay on one scale."""
+    e = gates.shape[-1]
+    importance = jnp.sum(first_onehot, axis=0)
+    frac_tokens = importance / jnp.maximum(jnp.sum(importance), 1.0)
+    return jnp.sum(frac_tokens * jnp.mean(gates, axis=0)) * e
+
+
 def _one_hot_routing(gates: jax.Array, capacity: int, top_k: int):
     """Build (dispatch [N,E,C], combine [N,E,C], aux_loss) from gate
     probabilities [N, E]."""
@@ -39,10 +83,12 @@ def _one_hot_routing(gates: jax.Array, capacity: int, top_k: int):
     remaining = gates
     # Track how full each expert queue already is from earlier choices.
     base_count = jnp.zeros((e,), jnp.int32)
-    importance = jnp.zeros((e,), gates.dtype)
+    first_onehot = None
     for choice in range(top_k):
         idx = jnp.argmax(remaining, axis=-1)  # [N]
         onehot = jax.nn.one_hot(idx, e, dtype=gates.dtype)  # [N, E]
+        if choice == 0:
+            first_onehot = onehot
         prob = jnp.sum(gates * onehot, axis=-1)  # [N]
         pos = (
             jnp.cumsum(onehot, axis=0) - 1.0 + base_count[None, :]
@@ -59,16 +105,10 @@ def _one_hot_routing(gates: jax.Array, capacity: int, top_k: int):
         base_count = base_count + jnp.sum(
             onehot * keep[:, None], axis=0
         ).astype(jnp.int32)
-        if choice == 0:  # Switch-style: balance the first-choice fraction
-            importance = importance + jnp.sum(onehot, axis=0)
         remaining = remaining * (1.0 - onehot)  # mask chosen expert
     dispatch = sum(dispatch_slots)
     combine = sum(combine_weights)
-    # Load-balance aux loss over the FIRST choice distribution.
-    frac_tokens = importance / jnp.maximum(jnp.sum(importance), 1.0)
-    frac_probs = jnp.mean(gates, axis=0)
-    aux = jnp.sum(frac_tokens * frac_probs) * e
-    return dispatch, combine, aux
+    return dispatch, combine, _switch_aux_loss(gates, first_onehot)
 
 
 class MoEDecoderMlp(nn.Module):
@@ -109,38 +149,17 @@ class MoEDecoderMlp(nn.Module):
                 f"{self.num_experts}"
             )
         b, s, d = x.shape
-        e = self.num_experts
         tokens = x.reshape(b * s, d)
-        wg = self.param(
-            "gate", nn.initializers.lecun_normal(), (d, e), jnp.float32
+        wg, w1, b1, w2, b2 = _expert_params(
+            self, d, self.num_experts, self.hidden_dim
         )
-        w1 = self.param(
-            "w1", nn.initializers.lecun_normal(),
-            (e, d, self.hidden_dim), jnp.float32,
-        )
-        b1 = self.param("b1", nn.initializers.zeros, (e, self.hidden_dim))
-        w2 = self.param(
-            "w2", nn.initializers.lecun_normal(),
-            (e, self.hidden_dim, d), jnp.float32,
-        )
-        b2 = self.param("b2", nn.initializers.zeros, (e, d))
-
         gates = jax.nn.softmax(
             tokens.astype(jnp.float32) @ wg, axis=-1
         )  # [N, E]
-        combine = jnp.zeros_like(gates)
-        remaining = gates
-        for _ in range(self.top_k):
-            idx = jnp.argmax(remaining, axis=-1)
-            onehot = jax.nn.one_hot(idx, e, dtype=gates.dtype)
-            combine = combine + onehot * gates
-            remaining = remaining * (1.0 - onehot)
+        combine, first_onehot = _topk_combine(gates, self.top_k)
         self.sow(
             "intermediates", "aux_loss",
-            jnp.sum(
-                (jnp.sum(combine > 0, axis=0) / combine.shape[0])
-                * jnp.mean(gates, axis=0)
-            ) * e,
+            _switch_aux_loss(gates, first_onehot),
         )
 
         xt = tokens.astype(self.dtype)
@@ -182,24 +201,9 @@ class MoEMlp(nn.Module):
             1, math.ceil(self.capacity_factor * n * self.top_k / e)
         )
         tokens = x.reshape(n, d)
-
-        wg = self.param(
-            "gate", nn.initializers.lecun_normal(), (d, e), jnp.float32
+        wg, w1, b1, w2, b2 = _expert_params(
+            self, d, e, self.hidden_dim
         )
-        w1 = self.param(
-            "w1",
-            nn.initializers.lecun_normal(),
-            (e, d, self.hidden_dim),
-            jnp.float32,
-        )
-        b1 = self.param("b1", nn.initializers.zeros, (e, self.hidden_dim))
-        w2 = self.param(
-            "w2",
-            nn.initializers.lecun_normal(),
-            (e, self.hidden_dim, d),
-            jnp.float32,
-        )
-        b2 = self.param("b2", nn.initializers.zeros, (e, d))
 
         gates = jax.nn.softmax(
             (tokens.astype(jnp.float32)) @ wg, axis=-1
