@@ -892,6 +892,12 @@ class ContinuousBatcher:
             "admitted": self._admitted,
             "completed": self._completed,
             "ticks": self._ticks,
+            # Resident KV bytes across layouts (slot strips, int8 value+
+            # scale pairs, or page pools) — the capacity number benches
+            # and dashboards report.
+            "cache_bytes": sum(
+                x.nbytes for x in jax.tree.leaves(self._caches)
+            ),
         }
         if self._paged:
             ps = self._pager.stats()

@@ -37,7 +37,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks.common import int_flag  # noqa: E402  (imports no JAX)
+from benchmarks.common import int_flag, str_flag  # noqa: E402  (no JAX)
 
 VOCAB, DIM, DEPTH, HEADS, MLP = 50257, 768, 12, 12, 3072
 PROMPT_LEN, MAX_LEN = 32, 256
@@ -48,7 +48,8 @@ OUT = os.path.join(
 )
 
 
-def _child(slots: int, n_requests: int, small: bool, chunk: int) -> None:
+def _child(slots: int, n_requests: int, small: bool, chunk: int,
+           layout: str) -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -80,7 +81,19 @@ def _child(slots: int, n_requests: int, small: bool, chunk: int) -> None:
     total_tokens = sum(steps)
 
     # -- continuous ------------------------------------------------------
-    bat = ContinuousBatcher(lm, variables, slots=slots, chunk=chunk)
+    # layout="paged": the page-pool cache + scalar-prefetch kernels
+    # (worst-case pool so the A/B vs the slot layout is throughput
+    # apples-to-apples; capacity sizing is a separate knob). At this
+    # workload's geometry (max_len 256, page 128) every request needs
+    # its full 2 pages, so the interesting number is kernel-path
+    # throughput vs the slot layout's einsum, on identical traffic.
+    kw = (
+        {"kv_layout": "paged", "page_size": 128}
+        if layout == "paged"
+        else {}
+    )
+    bat = ContinuousBatcher(lm, variables, slots=slots, chunk=chunk, **kw)
+    cache_bytes = bat.stats()["cache_bytes"]
     # Warm the compiled pieces (bucket prefill + step) out of the timed
     # region, mirroring generate()'s warmup below.
     bat.submit(prompts[0], 2)
@@ -108,10 +121,12 @@ def _child(slots: int, n_requests: int, small: bool, chunk: int) -> None:
 
     cont_tps = total_tokens / cont_s
     sync_tps = total_tokens / sync_s
+    suffix = "_paged" if layout == "paged" else ""
     print(
         json.dumps(
             {
-                "metric": f"continuous_serve_slots{slots}_tokens_per_sec",
+                "metric":
+                f"continuous_serve_slots{slots}{suffix}_tokens_per_sec",
                 "value": round(cont_tps, 2),
                 "unit": "tokens/sec",
                 "vs_baseline": round(cont_tps / sync_tps, 4),
@@ -122,6 +137,8 @@ def _child(slots: int, n_requests: int, small: bool, chunk: int) -> None:
                 "requests": n_requests,
                 "slots": slots,
                 "chunk": chunk,
+                "kv_layout": layout,
+                "cache_bytes": cache_bytes,
                 "step_mix": list(STEP_MIX),
                 "continuous_s": round(cont_s, 3),
                 "batch_sync_s": round(sync_s, 3),
@@ -135,18 +152,21 @@ def main() -> int:
     slots = int_flag(sys.argv, "--slots", 8)
     n_requests = int_flag(sys.argv, "--requests", 32)
     chunk = int_flag(sys.argv, "--chunk", 8)
+    layout = str_flag(sys.argv, "--layout", "slots",
+                      choices=("slots", "paged"))
     cpu = "--cpu" in sys.argv
     if "--child" in sys.argv:
-        _child(slots, n_requests, cpu, chunk)
+        _child(slots, n_requests, cpu, chunk, layout)
         return 0
     env = dict(os.environ)
     if cpu:
         env.pop("PYTHONPATH", None)
         env["JAX_PLATFORMS"] = "cpu"
-    metric = f"continuous_serve_slots{slots}_tokens_per_sec"
+    suffix = "_paged" if layout == "paged" else ""
+    metric = f"continuous_serve_slots{slots}{suffix}_tokens_per_sec"
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            "--slots", str(slots), "--requests", str(n_requests),
-           "--chunk", str(chunk)]
+           "--chunk", str(chunk), "--layout", layout]
     if cpu:
         cmd.append("--cpu")
     try:

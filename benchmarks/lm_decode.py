@@ -56,9 +56,21 @@ VOCAB, DIM, DEPTH, HEADS, MLP = 50257, 768, 12, 12, 3072
 TPU_V5E_HBM_BYTES_PER_S = 819e9
 
 
+def metric_suffix(kv: str, decode_attn: str, moe: int) -> str:
+    """ONE metric-name builder for parent and child: the parent's
+    error-row metric (on child failure) must equal the child's
+    success-row metric or A/B rows fork across keys."""
+    s = "_kv_int8" if kv == "int8" else ""
+    if decode_attn != "auto":
+        s += f"_attn_{decode_attn}"
+    if moe > 0:
+        s += f"_moe{moe}"
+    return s
+
+
 def _child(
     batch: int, steps: int, trials: int, prompt_len: int, max_len: int,
-    kv: str, decode_attn: str,
+    kv: str, decode_attn: str, moe: int,
 ) -> None:
     import jax
     import jax.numpy as jnp
@@ -66,8 +78,17 @@ def _child(
 
     from adapt_tpu.models.transformer_lm import generate, transformer_lm
 
+    # --moe E swaps every block's MLP for a dropless top-2 mixture of E
+    # experts (models/moe.MoEDecoderMlp). Single chip = the dense-EP
+    # degenerate case: every step streams ALL expert weights, so
+    # param_bytes (and the MBU ceiling) below scale with E
+    # automatically — the honest single-chip MoE number; the E/ep
+    # division shows up only on a real ep mesh.
     lm = transformer_lm(
-        VOCAB, DIM, DEPTH, HEADS, MLP, max_len=max_len, dtype=jnp.bfloat16
+        VOCAB, DIM, DEPTH, HEADS, MLP, max_len=max_len,
+        dtype=jnp.bfloat16,
+        moe_experts=moe if moe > 0 else None,
+        moe_top_k=2 if moe > 0 else 1,
     )
     key = jax.random.PRNGKey(0)
     prompt = jax.random.randint(key, (batch, prompt_len), 0, VOCAB)
@@ -130,9 +151,7 @@ def _child(
     ceiling_steps_s = TPU_V5E_HBM_BYTES_PER_S / (param_bytes + cache_bytes)
     mbu = (cached_tok_s / batch) / ceiling_steps_s
 
-    suffix = "_kv_int8" if kv_dtype == "int8" else ""
-    if decode_attn != "auto":
-        suffix += f"_attn_{decode_attn}"
+    suffix = metric_suffix(kv_dtype, decode_attn, moe)
     print(
         json.dumps(
             {
@@ -147,7 +166,8 @@ def _child(
                 "device": str(jax.devices()[0]),
                 "config": f"vocab{VOCAB} d{DIM} L{DEPTH} h{HEADS} "
                 f"prompt{prompt_len} steps{steps} max_len{max_len} bf16 "
-                f"kv={kv_dtype}",
+                f"kv={kv_dtype}"
+                + (f" moe{moe}top2" if moe > 0 else ""),
                 "param_bytes": param_bytes,
                 "kv_cache_bytes": cache_bytes,
                 "cached_s_per_trial": round(cached_s, 4),
@@ -167,17 +187,17 @@ def main() -> int:
     decode_attn = str_flag(
         sys.argv, "--decode-attn", "auto", choices=("auto", "xla", "pallas")
     )
+    moe = int_flag(sys.argv, "--moe", 0)
     if "--child" in sys.argv:
-        _child(batch, steps, trials, prompt_len, max_len, kv, decode_attn)
+        _child(batch, steps, trials, prompt_len, max_len, kv, decode_attn,
+               moe)
         return 0
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            "--batch", str(batch), "--steps", str(steps),
            "--trials", str(trials), "--prompt", str(prompt_len),
            "--maxlen", str(max_len), "--kv", kv,
-           "--decode-attn", decode_attn]
-    suffix = "_kv_int8" if kv == "int8" else ""
-    if decode_attn != "auto":
-        suffix += f"_attn_{decode_attn}"
+           "--decode-attn", decode_attn, "--moe", str(moe)]
+    suffix = metric_suffix(kv, decode_attn, moe)
     return run_child_json(
         cmd,
         metric=f"lm_decode_bs{batch}_tokens_per_sec{suffix}",

@@ -43,4 +43,12 @@ timeout 2700 python benchmarks/continuous_serve.py --slots 8 \
   --requests 32 --chunk 16 | tail -1
 # (driver appends a JSONL row to results/r04/continuous_serve.json)
 
+log "4. paged layout A/B on the same serving workload (kernel path)"
+timeout 2700 python benchmarks/continuous_serve.py --slots 8 \
+  --requests 32 --chunk 16 --layout paged | tail -1
+
+log "5. MoE decode: 8 experts top-2 at GPT-2 width (single-chip dense-EP)"
+timeout 1800 python benchmarks/lm_decode.py --moe 8 | tail -1 \
+  | tee "$OUT/lm_decode_moe8.json"
+
 log "queue3 done"

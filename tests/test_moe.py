@@ -201,3 +201,26 @@ def test_moe_lm_expert_sharded_generate_matches(devices):
     placed = place_experts(variables, mesh, num_experts=8)
     got = np.asarray(generate(lm, placed, prompt, steps=4))
     np.testing.assert_array_equal(got, want)
+
+
+def test_both_moe_layers_sow_one_aux_convention(rng):
+    """The refactor's invariant: MoEMlp (capacity-routed) and
+    MoEDecoderMlp (dropless) sow the SAME Switch-style aux_loss for the
+    same inputs — one scale, one threshold, as the docstrings promise."""
+    from adapt_tpu.models.moe import MoEDecoderMlp
+
+    x = jax.random.normal(rng, (B, S, D))
+    train = MoEMlp(num_experts=E, hidden_dim=H, top_k=1,
+                   capacity_factor=float(E))
+    serve = MoEDecoderMlp(num_experts=E, hidden_dim=H, top_k=1)
+    tv = train.init(jax.random.PRNGKey(1), x)
+    # Same gate weights -> same routing distribution for both layers.
+    sv = jax.tree.map(lambda a: a, serve.init(jax.random.PRNGKey(1), x))
+    sv["params"]["gate"] = tv["params"]["gate"]
+    _, ts = train.apply(tv, x, mutable=["intermediates"])
+    _, ss = serve.apply(sv, x, mutable=["intermediates"])
+    np.testing.assert_allclose(
+        float(ts["intermediates"]["aux_loss"][0]),
+        float(ss["intermediates"]["aux_loss"][0]),
+        rtol=1e-6,
+    )
