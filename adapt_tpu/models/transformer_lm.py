@@ -68,6 +68,12 @@ class CausalSelfAttention(nn.Module):
     heads: int
     dtype: jnp.dtype = jnp.float32
     kv_heads: int | None = None
+    #: Sliding-window attention (Mistral-style): each position attends
+    #: the previous ``window`` positions only. Decode-side this is just
+    #: a dynamic ``valid_from`` (the kernels need no change, and paged
+    #: serving can RECYCLE pages behind the window); full-sequence
+    #: forwards band the causal mask.
+    window: int | None = None
 
     def setup(self):
         if self.dim % self.heads:
@@ -153,9 +159,24 @@ class CausalSelfAttention(nn.Module):
         b, s, d = x.shape
         q, k, v = self._project(x)
         o = flash_attention(
-            q, self._repeat_kv(k), self._repeat_kv(v), causal=True
+            q, self._repeat_kv(k), self._repeat_kv(v), causal=True,
+            window=self.window,
         )
         return self.out(jnp.swapaxes(o, 1, 2).reshape(b, s, d))
+
+    def _window_from(self, index, b, valid_from):
+        """Effective ``valid_from`` for cached decode under a sliding
+        window: the window's left edge per row, max-composed with any
+        ragged left padding. None when windowless and dense."""
+        if self.window is None:
+            return valid_from
+        idx = jnp.broadcast_to(
+            jnp.asarray(index, jnp.int32).reshape(-1), (b,)
+        )
+        w_from = jnp.maximum(idx - self.window + 1, 0)
+        if valid_from is not None:
+            w_from = jnp.maximum(w_from, valid_from)
+        return w_from
 
     # One scale per cached key/value vector — the shared scheme in
     # ops.quantize (the kernel tests and on-chip smoke quantize with the
@@ -187,7 +208,7 @@ class CausalSelfAttention(nn.Module):
         q, k, v = self._project(x)
         o = flash_attention(
             q, self._repeat_kv(k), self._repeat_kv(v),
-            causal=True, valid_from=valid_from,
+            causal=True, valid_from=valid_from, window=self.window,
         )
         pad = ((0, 0), (0, 0), (0, max_len - s), (0, 0))
         out = self.out(jnp.swapaxes(o, 1, 2).reshape(b, s, d))
@@ -248,7 +269,8 @@ class CausalSelfAttention(nn.Module):
             cache_k = self._cache_write(cache_k, k, index)
             cache_v = self._cache_write(cache_v, v, index)
         o = decode_attention(
-            q, cache_k, cache_v, index, valid_from, prefer=attn_impl
+            q, cache_k, cache_v, index,
+            self._window_from(index, b, valid_from), prefer=attn_impl,
         ).astype(x_t.dtype)
         o = self._ungroup_o(o, 1)  # (b, h, 1, hd)
         o = jnp.swapaxes(o, 1, 2).reshape(b, 1, self.dim)
@@ -295,8 +317,8 @@ class CausalSelfAttention(nn.Module):
             v[:, :, 0, :].astype(v_pool.dtype)
         )
         o = paged_attention(
-            q, k_pool, v_pool, page_table, index, valid_from,
-            prefer=attn_impl,
+            q, k_pool, v_pool, page_table, index,
+            self._window_from(index, b, valid_from), prefer=attn_impl,
         ).astype(x_t.dtype)
         o = self._ungroup_o(o, 1)
         o = jnp.swapaxes(o, 1, 2).reshape(b, 1, self.dim)
@@ -328,7 +350,8 @@ class CausalSelfAttention(nn.Module):
         k_pool = k_pool.at[chunk_pages].set(to_pages(k).astype(k_pool.dtype))
         v_pool = v_pool.at[chunk_pages].set(to_pages(v).astype(v_pool.dtype))
         o = paged_chunk_attention(
-            q, k_pool, v_pool, pages, pos0, c, prefer=attn_impl
+            q, k_pool, v_pool, pages, pos0, c, prefer=attn_impl,
+            window=self.window,
         ).astype(x.dtype)
         o = self._ungroup_o(o, c)  # (1, h, C, hd)
         o = jnp.swapaxes(o, 1, 2).reshape(b, c, self.dim)
@@ -361,6 +384,10 @@ class CausalSelfAttention(nn.Module):
         positions = jnp.arange(cache_k.shape[2])
         rows = jnp.arange(kc)
         live = positions[None, :] <= (index + rows)[:, None]  # (K, L)
+        if self.window is not None:
+            live = live & (
+                positions[None, :] > (index + rows)[:, None] - self.window
+            )
         live = jnp.tile(live, (self._group, 1))  # (g*K, L), K-major per member
         s = jnp.where(live[None, None], s, _NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
@@ -393,6 +420,7 @@ class DecoderBlock(nn.Module):
     kv_heads: int | None = None
     moe_experts: int | None = None
     moe_top_k: int = 1
+    window: int | None = None
 
     @property
     def cache_heads(self) -> int:
@@ -406,7 +434,8 @@ class DecoderBlock(nn.Module):
     def setup(self):
         self.ln1 = nn.LayerNorm(dtype=self.dtype)
         self.attn = CausalSelfAttention(
-            self.dim, self.heads, dtype=self.dtype, kv_heads=self.kv_heads
+            self.dim, self.heads, dtype=self.dtype, kv_heads=self.kv_heads,
+            window=self.window,
         )
         self.ln2 = nn.LayerNorm(dtype=self.dtype)
         if self.moe_experts is not None:
@@ -556,6 +585,7 @@ def transformer_lm(
     kv_heads: int | None = None,
     moe_experts: int | None = None,
     moe_top_k: int = 1,
+    window: int | None = None,
 ) -> TransformerLM:
     """``kv_heads < heads`` builds a grouped-query (GQA) decoder: KV
     caches shrink by ``heads // kv_heads`` (``kv_heads=1`` = MQA), the
@@ -567,7 +597,16 @@ def transformer_lm(
     Served by every decode path with exact cache parity, and
     EP-shardable via ``parallel.expert.place_experts`` — see
     :class:`DecoderBlock` / :class:`adapt_tpu.models.moe.MoEDecoderMlp`.
+
+    ``window`` builds a sliding-window (Mistral-style) decoder: each
+    position attends only the previous ``window`` positions. Cached
+    decode masks the window as a dynamic ``valid_from`` (no kernel
+    changes; blocks behind the window skip compute), and the paged
+    batcher RECYCLES pages that fall wholly behind it mid-request —
+    pool usage bounds by the window, not the sequence.
     """
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     g = LayerGraph(name)
     prev = g.add(
         "embed", TokenEmbed(vocab, dim, max_len, dtype=dtype), INPUT
@@ -577,7 +616,7 @@ def transformer_lm(
             f"decoder_block_{i}",
             DecoderBlock(dim, heads, mlp_dim, dtype=dtype,
                          kv_heads=kv_heads, moe_experts=moe_experts,
-                         moe_top_k=moe_top_k),
+                         moe_top_k=moe_top_k, window=window),
             prev,
         )
     g.add("head", LMHead(vocab, dtype=dtype), prev)

@@ -228,6 +228,7 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     prefer: str | None = None,
     valid_from: jax.Array | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Fused attention over (batch, heads, seq, head_dim) tensors.
 
@@ -262,12 +263,29 @@ def flash_attention(
     keys (which is also what the oracle emits) — no caller may read
     them; valid rows match the oracle exactly.
     """
-    if prefer is None:
-        prefer = "pallas" if scores_over_budget(q.shape, k.shape) else "xla"
-    elif prefer not in ("pallas", "xla"):
+    if prefer not in (None, "pallas", "xla"):
         raise ValueError(
             f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
         )
+    if window is not None:
+        # Sliding-window band mask: oracle-only for the full-sequence
+        # forward today — O(S^2) scores, so LONG windowed prompts should
+        # prefill incrementally instead (the batcher's chunked prefill
+        # runs the BANDED chunk kernel, and windowed DECODE needs no
+        # kernel change at all — the window rides the valid_from mask in
+        # ops/decode_attention). An explicit kernel request can't be
+        # honored and must not silently downgrade.
+        if prefer == "pallas":
+            raise ValueError(
+                "window is not yet supported by the streaming kernel "
+                "(banded variant is a known follow-up); use the oracle "
+                "path or chunked prefill for long windowed sequences"
+            )
+        return attention_reference(
+            q, k, v, causal=causal, valid_from=valid_from, window=window
+        )
+    if prefer is None:
+        prefer = "pallas" if scores_over_budget(q.shape, k.shape) else "xla"
     if prefer == "xla":
         return attention_reference(
             q, k, v, causal=causal, valid_from=valid_from
@@ -937,6 +955,7 @@ def attention_reference(
     causal: bool = False,
     valid_from: jax.Array | None = None,
     causal_shift: jax.Array | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Pure-jnp oracle: softmax(QK^T / sqrt(d)) V with optional masks.
 
@@ -947,15 +966,26 @@ def attention_reference(
     keys at positions < valid_from[row] — left-padding in ragged batches
     (the LM's masked prefill). ``causal_shift`` offsets the causal
     diagonal (row i attends j <= i - shift; see
-    :func:`flash_attention_with_lse`). One oracle, one set of
-    masking/precision conventions.
+    :func:`flash_attention_with_lse`). ``window`` (requires ``causal``)
+    bands the mask Mistral-style: row i attends j in
+    (i - window, i] — the sliding-window LM's full-sequence forward.
+    One oracle, one set of masking/precision conventions.
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     d = q.shape[-1]
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) / math.sqrt(d)
     if causal:
         s = jnp.where(_causal_mask(*s.shape[-2:], causal_shift), s, _NEG_INF)
+    if window is not None:
+        s_q, s_k = s.shape[-2:]
+        band = (
+            jnp.arange(s_k)[None, :]
+            > jnp.arange(s_q)[:, None] - window
+        )
+        s = jnp.where(band[None, None], s, _NEG_INF)
     if valid_from is not None:
         cols = jnp.arange(s.shape[-1])
         live = cols[None, :] >= valid_from[:, None]  # (b, s_k)

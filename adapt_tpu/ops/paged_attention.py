@@ -189,7 +189,7 @@ def _paged_kernel(table_ref, q_ref, k_ref, v_ref, idx_ref, *refs, block_k,
 
 
 def _chunk_kernel(pages_ref, q_ref, k_ref, v_ref, pos0_ref, *refs,
-                  block_k, num_kv, sm_scale, chunk):
+                  block_k, num_kv, sm_scale, chunk, window=None):
     """Chunk-query paged attention: q rows are a CHUNK of positions
     [pos0, pos0 + chunk) (GQA groups folded in, row = member*chunk + p)
     attending the paged window up to each row's own position — the
@@ -224,6 +224,12 @@ def _chunk_kernel(pages_ref, q_ref, k_ref, v_ref, pos0_ref, *refs,
             jnp.int32, (gc, block_k), 1
         )
         live = cols <= pos0_ref[0] + rows
+        if window is not None:
+            # Sliding window: row at absolute position p attends
+            # (p - window, p].
+            live = jnp.logical_and(
+                live, cols > pos0_ref[0] + rows - window
+            )
         s = jnp.where(live, s, -1e30)
         m = m_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -237,8 +243,14 @@ def _chunk_kernel(pages_ref, q_ref, k_ref, v_ref, pos0_ref, *refs,
         )
 
     # Pages entirely past the chunk's last position are dead (the pow2
-    # padding's trash pages land here too).
-    pl.when(j * block_k <= pos0_ref[0] + chunk - 1)(_step)
+    # padding's trash pages land here too); under a sliding window so
+    # are pages entirely below EVERY row's window (row 0's is lowest).
+    live_block = j * block_k <= pos0_ref[0] + chunk - 1
+    if window is not None:
+        live_block = jnp.logical_and(
+            live_block, (j + 1) * block_k - 1 > pos0_ref[0] - window
+        )
+    pl.when(live_block)(_step)
 
     @pl.when(j == num_kv - 1)
     def _emit():
@@ -248,10 +260,11 @@ def _chunk_kernel(pages_ref, q_ref, k_ref, v_ref, pos0_ref, *refs,
 
 
 def paged_chunk_attention_reference(q, k_pool, v_pool, pages, pos0,
-                                    chunk: int):
+                                    chunk: int, window: int | None = None):
     """jnp oracle for the chunk-query kernel: gather the window, mask
-    ``col <= pos0 + row % chunk``, softmax, weight. q is (1, kv_h, g*C,
-    hd) GROUP-FOLDED (row = member*C + position), pages (n,)."""
+    ``col <= pos0 + row % chunk`` (banded by ``window`` when set),
+    softmax, weight. q is (1, kv_h, g*C, hd) GROUP-FOLDED (row =
+    member*C + position), pages (n,)."""
     kvh, hd = k_pool.shape[1], k_pool.shape[3]
     gather = lambda pool: jnp.moveaxis(pool[pages], 1, 0).reshape(
         1, kvh, -1, hd
@@ -264,6 +277,8 @@ def paged_chunk_attention_reference(q, k_pool, v_pool, pages, pos0,
     rows = jnp.arange(q.shape[2]) % chunk
     cols = jnp.arange(k.shape[2])
     live = cols[None, :] <= pos0 + rows[:, None]
+    if window is not None:
+        live = live & (cols[None, :] > pos0 + rows[:, None] - window)
     s = jnp.where(live[None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum(
@@ -271,8 +286,8 @@ def paged_chunk_attention_reference(q, k_pool, v_pool, pages, pos0,
     ).astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("chunk",))
-def _chunk_impl(q, k_pool, v_pool, pages, pos0, chunk):
+@functools.partial(jax.jit, static_argnames=("chunk", "window"))
+def _chunk_impl(q, k_pool, v_pool, pages, pos0, chunk, window=None):
     _, kvh, gc, hd = q.shape
     page = k_pool.shape[2]
     n = pages.shape[0]
@@ -318,6 +333,7 @@ def _chunk_impl(q, k_pool, v_pool, pages, pos0, chunk):
             num_kv=n,
             sm_scale=1.0 / (hd ** 0.5),
             chunk=chunk,
+            window=window,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((kvh, gcp, hd), q.dtype),
@@ -341,6 +357,7 @@ def paged_chunk_attention(
     pos0,
     chunk: int,
     prefer: str | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Chunk-prefill attention over a paged window, in place — the
     incremental-prefill counterpart of :func:`paged_attention` (no
@@ -365,9 +382,9 @@ def paged_chunk_attention(
             f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
         )
     if prefer == "pallas" and supported:
-        return _chunk_impl(q, k_pool, v_pool, pages, pos0, chunk)
+        return _chunk_impl(q, k_pool, v_pool, pages, pos0, chunk, window)
     return paged_chunk_attention_reference(
-        q, k_pool, v_pool, pages, pos0, chunk
+        q, k_pool, v_pool, pages, pos0, chunk, window
     )
 
 

@@ -211,6 +211,9 @@ class ContinuousBatcher:
         self._head = g.node("head").module
         self._blocks = [g.node(n).module for n in lm.block_names]
         block0 = self._blocks[0]
+        #: Sliding-window models: decode masking lives in the model;
+        #: the batcher's job is page RECYCLING behind the window.
+        self._window = getattr(block0, "window", None)
         self._cache_len = lm.max_len + 1  # one trash slot for idle rows
         self._trash = lm.max_len
         # Slot caches hold KV heads: fewer than query heads under GQA
@@ -872,6 +875,20 @@ class ContinuousBatcher:
                 # pos invariant at tick entry: the next step consumes
                 # last_token (stream index emitted-1) at s0 + emitted - 1.
                 slot.pos = slot.s0 + slot.emitted - 1
+        if self._paged and self._window is not None:
+            # Rolling-window recycling: pages wholly behind every future
+            # read ((o+1)*P <= pos - window + 1 — reads from here on
+            # mask positions < index - window + 1 and writes land at
+            # >= pos) go back to the pool MID-REQUEST, so pool pressure
+            # bounds by the window, not the sequence.
+            for slot in self.slots:
+                if slot.req is None or slot.pf_done >= 0:
+                    continue
+                dead = max(
+                    0, slot.pos - self._window + 1
+                ) // self._page - self._pager.base(slot.idx)
+                if dead > 0:
+                    self._pager.release_prefix(slot.idx, dead)
         # Post-commit occupancy: slots retired by this chunk are gone.
         global_metrics().set_gauge(
             "continuous.active_slots",

@@ -68,6 +68,10 @@ class Pager:
         # helps test reproducibility; no perf meaning).
         self._free = list(range(num_pages - 1, 0, -1))
         self._owned: list[list[int]] = [[] for _ in range(slots)]
+        #: Rolling-window offset: how many LEADING logical ordinals of
+        #: each slot have been released mid-request (sliding-window
+        #: recycling). owned[0] then sits at table ordinal base[slot].
+        self._base: list[int] = [0 for _ in range(slots)]
         self._rc: dict[int, int] = {}
         # Content-addressed prefix registry: key -> page, both ways.
         self._by_key: dict[bytes, int] = {}
@@ -99,10 +103,10 @@ class Pager:
         the pool cannot cover it even after evicting every rc=0 cached
         page (caller leaves the request queued)."""
         owned = self._owned[slot]
-        if len(owned) + n > self.pages_per_slot:
+        if self._base[slot] + len(owned) + n > self.pages_per_slot:
             raise ValueError(
-                f"slot {slot}: {len(owned)}+{n} pages exceeds table "
-                f"width {self.pages_per_slot}"
+                f"slot {slot}: {self._base[slot]}+{len(owned)}+{n} pages "
+                f"exceeds table width {self.pages_per_slot}"
             )
         if not self.can_alloc(n):
             return False
@@ -112,29 +116,57 @@ class Pager:
             owned.append(page)
         return True
 
+    def _release_one(self, page: int) -> None:
+        """Drop one claim on ``page``; at rc=0 it returns to the free
+        list — unless registered as prefix cache, in which case it
+        stays resident and evictable (LRU)."""
+        self._rc[page] -= 1
+        if self._rc[page] == 0:
+            del self._rc[page]
+            if page in self._key_of:
+                self._lru[page] = None  # newest = last evicted
+            else:
+                self._free.append(page)
+
     def free_slot(self, slot: int) -> None:
-        """Drop ``slot``'s claim on all its pages. rc=0 pages return to
-        the free list — unless registered as prefix cache, in which
-        case they stay resident and evictable (LRU)."""
+        """Drop ``slot``'s claim on all its pages."""
         for page in reversed(self._owned[slot]):
-            self._rc[page] -= 1
-            if self._rc[page] == 0:
-                del self._rc[page]
-                if page in self._key_of:
-                    self._lru[page] = None  # newest = last evicted
-                else:
-                    self._free.append(page)
+            self._release_one(page)
         self._owned[slot] = []
+        self._base[slot] = 0
+
+    def release_prefix(self, slot: int, n: int) -> None:
+        """Sliding-window recycling: release ``slot``'s first ``n``
+        logical pages MID-REQUEST (they fell wholly behind the
+        attention window — masked forever, written never again). Their
+        table ordinals point at the trash page from here on; shared /
+        registered pages follow the usual rc / LRU rules, so a released
+        prompt page can still serve future prefix hits."""
+        if n <= 0:
+            return
+        if n > len(self._owned[slot]):
+            raise ValueError(
+                f"slot {slot}: releasing {n} of "
+                f"{len(self._owned[slot])} owned pages"
+            )
+        for page in self._owned[slot][:n]:
+            self._release_one(page)
+        self._owned[slot] = self._owned[slot][n:]
+        self._base[slot] += n
+
+    def base(self, slot: int) -> int:
+        return self._base[slot]
 
     def owned(self, slot: int) -> list[int]:
         return list(self._owned[slot])
 
     def table(self) -> np.ndarray:
-        """(slots, pages_per_slot) int32; unallocated entries -> trash
-        page 0."""
+        """(slots, pages_per_slot) int32; unallocated (and released)
+        entries -> trash page 0."""
         t = np.zeros((len(self._owned), self.pages_per_slot), np.int32)
         for i, pages in enumerate(self._owned):
-            t[i, : len(pages)] = pages
+            b = self._base[i]
+            t[i, b: b + len(pages)] = pages
         return t
 
     # -- prefix sharing ----------------------------------------------------

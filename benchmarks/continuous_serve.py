@@ -41,6 +41,14 @@ from benchmarks.common import int_flag, str_flag  # noqa: E402  (no JAX)
 
 VOCAB, DIM, DEPTH, HEADS, MLP = 50257, 768, 12, 12, 3072
 PROMPT_LEN, MAX_LEN = 32, 256
+
+
+def metric_name(slots: int, layout: str) -> str:
+    """ONE metric-name builder for parent and child (the parent's
+    error-row metric on child failure must equal the child's success
+    metric — same rule as lm_decode.metric_suffix)."""
+    suffix = "_paged" if layout == "paged" else ""
+    return f"continuous_serve_slots{slots}{suffix}_tokens_per_sec"
 STEP_MIX = (16, 96, 32, 128)  # short/long interleave — the convoy case
 OUT = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "results", "r04",
@@ -121,12 +129,10 @@ def _child(slots: int, n_requests: int, small: bool, chunk: int,
 
     cont_tps = total_tokens / cont_s
     sync_tps = total_tokens / sync_s
-    suffix = "_paged" if layout == "paged" else ""
     print(
         json.dumps(
             {
-                "metric":
-                f"continuous_serve_slots{slots}{suffix}_tokens_per_sec",
+                "metric": metric_name(slots, layout),
                 "value": round(cont_tps, 2),
                 "unit": "tokens/sec",
                 "vs_baseline": round(cont_tps / sync_tps, 4),
@@ -162,8 +168,7 @@ def main() -> int:
     if cpu:
         env.pop("PYTHONPATH", None)
         env["JAX_PLATFORMS"] = "cpu"
-    suffix = "_paged" if layout == "paged" else ""
-    metric = f"continuous_serve_slots{slots}{suffix}_tokens_per_sec"
+    metric = metric_name(slots, layout)
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            "--slots", str(slots), "--requests", str(n_requests),
            "--chunk", str(chunk), "--layout", layout]
