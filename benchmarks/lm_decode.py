@@ -56,7 +56,7 @@ VOCAB, DIM, DEPTH, HEADS, MLP = 50257, 768, 12, 12, 3072
 TPU_V5E_HBM_BYTES_PER_S = 819e9
 
 
-def metric_suffix(kv: str, decode_attn: str, moe: int) -> str:
+def metric_suffix(kv: str, decode_attn: str, moe: int, window: int) -> str:
     """ONE metric-name builder for parent and child: the parent's
     error-row metric (on child failure) must equal the child's
     success-row metric or A/B rows fork across keys."""
@@ -65,12 +65,14 @@ def metric_suffix(kv: str, decode_attn: str, moe: int) -> str:
         s += f"_attn_{decode_attn}"
     if moe > 0:
         s += f"_moe{moe}"
+    if window > 0:
+        s += f"_win{window}"
     return s
 
 
 def _child(
     batch: int, steps: int, trials: int, prompt_len: int, max_len: int,
-    kv: str, decode_attn: str, moe: int,
+    kv: str, decode_attn: str, moe: int, window: int,
 ) -> None:
     import jax
     import jax.numpy as jnp
@@ -84,11 +86,16 @@ def _child(
     # param_bytes (and the MBU ceiling) below scale with E
     # automatically — the honest single-chip MoE number; the E/ep
     # division shows up only on a real ep mesh.
+    # --window W bands attention Mistral-style: decode masks (and with
+    # the Pallas decode path, compute-SKIPS) everything behind the
+    # window — the A/B against the full-attention row shows what the
+    # serving path buys at long context.
     lm = transformer_lm(
         VOCAB, DIM, DEPTH, HEADS, MLP, max_len=max_len,
         dtype=jnp.bfloat16,
         moe_experts=moe if moe > 0 else None,
         moe_top_k=2 if moe > 0 else 1,
+        window=window if window > 0 else None,
     )
     key = jax.random.PRNGKey(0)
     prompt = jax.random.randint(key, (batch, prompt_len), 0, VOCAB)
@@ -147,11 +154,18 @@ def _child(
     vec_bytes = (
         head_dim * 1 + 4 if kv_dtype == "int8" else head_dim * 2
     )  # per K or V vector
-    cache_bytes = 2 * DEPTH * batch * HEADS * max_len * vec_bytes
+    # Sliding window: the IDEAL per-step cache traffic is the window,
+    # not the buffer — the ceiling must reflect it or the windowed
+    # pallas row (whose kernel really does skip dead blocks) reports an
+    # inflated MBU while the XLA row (which streams the whole buffer)
+    # hides its overhead. One window-bounded ceiling keeps both honest:
+    # the kernel approaches it, the einsum path shows the gap.
+    eff_len = min(max_len, window) if window > 0 else max_len
+    cache_bytes = 2 * DEPTH * batch * HEADS * eff_len * vec_bytes
     ceiling_steps_s = TPU_V5E_HBM_BYTES_PER_S / (param_bytes + cache_bytes)
     mbu = (cached_tok_s / batch) / ceiling_steps_s
 
-    suffix = metric_suffix(kv_dtype, decode_attn, moe)
+    suffix = metric_suffix(kv_dtype, decode_attn, moe, window)
     print(
         json.dumps(
             {
@@ -167,7 +181,8 @@ def _child(
                 "config": f"vocab{VOCAB} d{DIM} L{DEPTH} h{HEADS} "
                 f"prompt{prompt_len} steps{steps} max_len{max_len} bf16 "
                 f"kv={kv_dtype}"
-                + (f" moe{moe}top2" if moe > 0 else ""),
+                + (f" moe{moe}top2" if moe > 0 else "")
+                + (f" window{window}" if window > 0 else ""),
                 "param_bytes": param_bytes,
                 "kv_cache_bytes": cache_bytes,
                 "cached_s_per_trial": round(cached_s, 4),
@@ -188,16 +203,18 @@ def main() -> int:
         sys.argv, "--decode-attn", "auto", choices=("auto", "xla", "pallas")
     )
     moe = int_flag(sys.argv, "--moe", 0)
+    window = int_flag(sys.argv, "--window", 0)
     if "--child" in sys.argv:
         _child(batch, steps, trials, prompt_len, max_len, kv, decode_attn,
-               moe)
+               moe, window)
         return 0
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            "--batch", str(batch), "--steps", str(steps),
            "--trials", str(trials), "--prompt", str(prompt_len),
            "--maxlen", str(max_len), "--kv", kv,
-           "--decode-attn", decode_attn, "--moe", str(moe)]
-    suffix = metric_suffix(kv, decode_attn, moe)
+           "--decode-attn", decode_attn, "--moe", str(moe),
+           "--window", str(window)]
+    suffix = metric_suffix(kv, decode_attn, moe, window)
     return run_child_json(
         cmd,
         metric=f"lm_decode_bs{batch}_tokens_per_sec{suffix}",

@@ -51,4 +51,12 @@ log "5. MoE decode: 8 experts top-2 at GPT-2 width (single-chip dense-EP)"
 timeout 1800 python benchmarks/lm_decode.py --moe 8 | tail -1 \
   | tee "$OUT/lm_decode_moe8.json"
 
+log "6. sliding-window decode at 4k context (vs step 2's full-attention rows)"
+timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
+  --steps 128 --window 1024 | tail -1 \
+  | tee "$OUT/lm_decode_4k_win1024.json"
+timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
+  --steps 128 --window 1024 --decode-attn pallas | tail -1 \
+  | tee "$OUT/lm_decode_4k_win1024_pallas.json"
+
 log "queue3 done"
