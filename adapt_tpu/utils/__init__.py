@@ -1,3 +1,4 @@
+from adapt_tpu.utils.exporter import prometheus_text, serve_metrics
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import MetricsRegistry, global_metrics
 from adapt_tpu.utils.tracing import Tracer, global_tracer
@@ -6,6 +7,8 @@ __all__ = [
     "get_logger",
     "MetricsRegistry",
     "global_metrics",
+    "prometheus_text",
+    "serve_metrics",
     "Tracer",
     "global_tracer",
 ]

@@ -1,0 +1,104 @@
+"""HTTP metrics exporter: scrape the process's MetricsRegistry.
+
+The observability surface SURVEY.md §5 calls for, made scrapeable: a
+stdlib ``ThreadingHTTPServer`` serving
+
+- ``GET /metrics`` — Prometheus text exposition (counters as
+  ``adapt_<name>_total``, gauges as ``adapt_<name>``, histograms as
+  ``_count`` / ``_sum`` plus p50/p99 gauges; dots in metric names become
+  underscores),
+- ``GET /metrics.json`` — the raw :meth:`MetricsRegistry.snapshot`,
+- ``GET /healthz`` — ``{"ok": true}`` liveness.
+
+Serving-side components (dispatcher, continuous batcher, gateway) all
+write the shared :func:`adapt_tpu.utils.metrics.global_metrics`
+registry, so one exporter per process covers them. Start with
+``serve_metrics(port)`` (daemon thread, returns the server; ``port=0``
+picks a free port — tests use that) and stop with ``.shutdown()``.
+
+No reference analog: the reference's only telemetry is ``print()``
+(SURVEY.md §5, ``/root/reference/src/dispatcher.py:129,147-150``).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from adapt_tpu.utils.logging import get_logger
+from adapt_tpu.utils.metrics import MetricsRegistry, global_metrics
+
+log = get_logger("exporter")
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    return "adapt_" + _NAME_RE.sub("_", name)
+
+
+def prometheus_text(snapshot: dict) -> str:
+    """Render a :meth:`MetricsRegistry.snapshot` in the Prometheus text
+    exposition format (one line per sample; histograms as count/sum +
+    percentile gauges — enough for dashboards without native histogram
+    buckets)."""
+    lines: list[str] = []
+    for name, value in sorted(snapshot.get("counters", {}).items()):
+        lines.append(f"{_prom_name(name)}_total {value}")
+    for name, value in sorted(snapshot.get("gauges", {}).items()):
+        lines.append(f"{_prom_name(name)} {value}")
+    for name, summ in sorted(snapshot.get("histograms", {}).items()):
+        base = _prom_name(name)
+        lines.append(f"{base}_count {summ.get('count', 0)}")
+        if summ.get("count"):
+            lines.append(f"{base}_sum {summ['sum']}")
+            for p in ("p50", "p99"):
+                lines.append(f"{base}_{p} {summ[p]}")
+    return "\n".join(lines) + "\n"
+
+
+def serve_metrics(
+    port: int = 9100,
+    host: str = "127.0.0.1",
+    registry: MetricsRegistry | None = None,
+) -> ThreadingHTTPServer:
+    """Start the exporter on a daemon thread; returns the server
+    (``.server_address[1]`` is the bound port). Stop with
+    ``.shutdown()`` then ``.server_close()`` — shutdown alone stops the
+    loop but leaks the listening socket. ``registry`` defaults to the
+    process-global one."""
+    reg = registry if registry is not None else global_metrics()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path == "/metrics":
+                body = prometheus_text(reg.snapshot()).encode()
+                ctype = "text/plain; version=0.0.4"
+            elif self.path == "/metrics.json":
+                body = json.dumps(reg.snapshot()).encode()
+                ctype = "application/json"
+            elif self.path == "/healthz":
+                body = b'{"ok": true}'
+                ctype = "application/json"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # scrapes are not log events
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(
+        target=server.serve_forever, name="metrics-exporter", daemon=True
+    )
+    thread.start()
+    log.info("metrics exporter on %s:%d", host, server.server_address[1])
+    return server

@@ -40,13 +40,22 @@ class _Histogram:
     def summary(self) -> dict:
         if self.count == 0:
             return {"count": 0}
+        # ONE sort for every percentile: summary() runs under the
+        # registry lock (snapshot()), and a scrape must not stall
+        # serving-path observe() calls on repeated reservoir sorts.
+        s = sorted(self._samples)
+
+        def pct(p):
+            return s[min(len(s) - 1, int(p / 100.0 * len(s)))]
+
         return {
             "count": self.count,
+            "sum": self.total,
             "mean": self.total / self.count,
             "min": self.min,
             "max": self.max,
-            "p50": self.percentile(50),
-            "p99": self.percentile(99),
+            "p50": pct(50),
+            "p99": pct(99),
         }
 
 

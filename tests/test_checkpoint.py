@@ -93,3 +93,4 @@ def test_restore_missing_dir_raises(tmp_path):
     with TrainCheckpointer(tmp_path / "empty") as ck:
         with pytest.raises(FileNotFoundError):
             ck.restore({}, {})
+

@@ -1,0 +1,52 @@
+"""HTTP metrics exporter: scrape paths, formats, and teardown."""
+
+import json
+import urllib.error
+import urllib.request
+
+from adapt_tpu.utils.exporter import serve_metrics
+from adapt_tpu.utils.metrics import MetricsRegistry
+
+
+def test_metrics_exporter_serves_prom_and_json():
+    reg = MetricsRegistry()
+    reg.inc("dispatcher.completed", 5)
+    reg.set_gauge("continuous.active_slots", 3)
+    reg.observe("stage.latency_s", 0.1)
+    reg.observe("stage.latency_s", 0.3)
+    server = serve_metrics(port=0, registry=reg)
+    try:
+        port = server.server_address[1]
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                return r.read().decode(), r.headers.get("Content-Type")
+
+        text, ctype = get("/metrics")
+        assert "text/plain" in ctype
+        assert "adapt_dispatcher_completed_total 5" in text
+        assert "adapt_continuous_active_slots 3" in text
+        assert "adapt_stage_latency_s_count 2" in text
+        # _sum is the exact running total, not mean*count.
+        assert "adapt_stage_latency_s_sum 0.4" in text
+        assert "adapt_stage_latency_s_p50" in text
+
+        js, ctype = get("/metrics.json")
+        snap = json.loads(js)
+        assert snap["counters"]["dispatcher.completed"] == 5
+        assert snap["histograms"]["stage.latency_s"]["sum"] == 0.4
+        assert "application/json" in ctype
+
+        ok, _ = get("/healthz")
+        assert json.loads(ok)["ok"] is True
+
+        try:
+            get("/nope")
+            raise AssertionError("404 expected")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.shutdown()
+        server.server_close()  # shutdown alone leaks the listening fd
