@@ -45,6 +45,31 @@ from adapt_tpu.ops.quantize import quantize_kv_vectors
 _NEG_INF = -1e30
 
 
+def apply_rope(x: jax.Array, positions: jax.Array,
+               base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding over (b, heads, s, head_dim) with
+    explicit ``positions`` ((s,) shared or (b, s) per row — per-row
+    LOGICAL positions keep ragged rows bitwise-equal to their solo
+    runs). Rotate-half convention; head_dim must be even. Computed in
+    f32 and cast back (rotation is a unitary mix — doing it in bf16
+    would cost precision every cached step)."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = jnp.asarray(positions, jnp.float32)
+    if pos.ndim == 1:
+        angles = pos[None, :, None] * freqs  # (1, s, half)
+    else:
+        angles = pos[:, :, None] * freqs  # (b, s, half)
+    cos = jnp.cos(angles)[:, None, :, :]  # (b|1, 1, s, half)
+    sin = jnp.sin(angles)[:, None, :, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
 class CausalSelfAttention(nn.Module):
     """Causal MHA/GQA sharing weights between the full-sequence path
     (flash dispatch) and the single-token cached path.
@@ -74,11 +99,20 @@ class CausalSelfAttention(nn.Module):
     #: serving can RECYCLE pages behind the window); full-sequence
     #: forwards band the causal mask.
     window: int | None = None
+    #: Rotary position embeddings: q/k rotate by their LOGICAL position
+    #: (buffer position minus ragged left padding, so padded rows equal
+    #: their solo runs bitwise); the cache stores POST-rotation K, so
+    #: every cached decode path works unchanged.
+    rope: bool = False
 
     def setup(self):
         if self.dim % self.heads:
             raise ValueError(
                 f"model dim {self.dim} not divisible by {self.heads} heads"
+            )
+        if self.rope and (self.dim // self.heads) % 2:
+            raise ValueError(
+                f"rope needs an even head_dim, got {self.dim // self.heads}"
             )
         head_dim = self.dim // self.heads
         kvh = self.kv_heads
@@ -155,9 +189,18 @@ class CausalSelfAttention(nn.Module):
         b, kvh, gs, hd = o.shape
         return o.reshape(b, kvh * (gs // s), s, hd)
 
+    def _rope_qk(self, q, k, positions):
+        """Rotate q and k by ``positions`` when rope is on (no-op
+        otherwise). Runs BEFORE GQA group folding / caching, so the
+        cache holds post-rotation K."""
+        if not self.rope:
+            return q, k
+        return apply_rope(q, positions), apply_rope(k, positions)
+
     def __call__(self, x):
         b, s, d = x.shape
         q, k, v = self._project(x)
+        q, k = self._rope_qk(q, k, jnp.arange(s))
         o = flash_attention(
             q, self._repeat_kv(k), self._repeat_kv(v), causal=True,
             window=self.window,
@@ -206,6 +249,12 @@ class CausalSelfAttention(nn.Module):
         size. Caches become ``(int8 values, f32 scales)`` pairs."""
         b, s, d = x.shape
         q, k, v = self._project(x)
+        pos = jnp.arange(s)
+        if valid_from is not None:
+            # LOGICAL positions (0 at each row's first real token) keep
+            # a ragged row's rotations bitwise-equal to its solo run.
+            pos = pos[None, :] - valid_from[:, None]
+        q, k = self._rope_qk(q, k, pos)
         o = flash_attention(
             q, self._repeat_kv(k), self._repeat_kv(v),
             causal=True, valid_from=valid_from, window=self.window,
@@ -253,6 +302,12 @@ class CausalSelfAttention(nn.Module):
         kernel that dequantizes int8 caches in VMEM."""
         b = x_t.shape[0]
         q, k, v = self._project(x_t)  # q (b, h, 1, hd); k/v (b, kv_h, 1, hd)
+        if self.rope:
+            idx = jnp.broadcast_to(
+                jnp.asarray(index, jnp.int32).reshape(-1), (b,)
+            )
+            logical = idx - (0 if valid_from is None else valid_from)
+            q, k = self._rope_qk(q, k, logical[:, None])
         # GQA: fold query-head groups into query rows so the attention
         # runs unchanged against the small (b, kv_h, L, hd) cache.
         q = self._group_q(q)  # (b, kv_h, g, hd)
@@ -293,10 +348,13 @@ class CausalSelfAttention(nn.Module):
         b = x_t.shape[0]
         page = k_pool.shape[2]
         q, k, v = self._project(x_t)  # q (b, h, 1, hd); k/v (b, kv_h, 1, hd)
-        q = self._group_q(q)  # (b, kv_h, g, hd)
         idx = jnp.broadcast_to(
             jnp.asarray(index, jnp.int32).reshape(-1), (b,)
         )
+        if self.rope:
+            logical = idx - (0 if valid_from is None else valid_from)
+            q, k = self._rope_qk(q, k, logical[:, None])
+        q = self._group_q(q)  # (b, kv_h, g, hd)
         # Negative index = dead row (idle or mid-chunked-prefill slot in
         # a lockstep batch). Its garbage write MUST go to the trash page
         # — the row may own real pages (a prefilling slot does), and
@@ -338,6 +396,7 @@ class CausalSelfAttention(nn.Module):
         b, c, d = x.shape
         page = k_pool.shape[2]
         q, k, v = self._project(x)  # q (1, h, C, hd); k/v (1, kv_h, C, hd)
+        q, k = self._rope_qk(q, k, pos0 + jnp.arange(c))
         q = self._group_q(q)  # (1, kv_h, g*C, hd)
         n_chunk = c // page
         chunk_pages = lax.dynamic_slice(
@@ -369,6 +428,7 @@ class CausalSelfAttention(nn.Module):
         trash-slot discipline the continuous batcher uses)."""
         b, kc, d = x.shape
         q, k, v = self._project(x)  # q (b, h, K, hd); k/v (b, kv_h, K, hd)
+        q, k = self._rope_qk(q, k, index + jnp.arange(kc))
         q = self._group_q(q)  # (b, kv_h, g*K, hd), row = member*K + pos
         sm = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
         cache_k = lax.dynamic_update_slice(cache_k, k, (0, 0, index, 0))
@@ -421,6 +481,7 @@ class DecoderBlock(nn.Module):
     moe_experts: int | None = None
     moe_top_k: int = 1
     window: int | None = None
+    rope: bool = False
 
     @property
     def cache_heads(self) -> int:
@@ -435,7 +496,7 @@ class DecoderBlock(nn.Module):
         self.ln1 = nn.LayerNorm(dtype=self.dtype)
         self.attn = CausalSelfAttention(
             self.dim, self.heads, dtype=self.dtype, kv_heads=self.kv_heads,
-            window=self.window,
+            window=self.window, rope=self.rope,
         )
         self.ln2 = nn.LayerNorm(dtype=self.dtype)
         if self.moe_experts is not None:
@@ -505,38 +566,52 @@ class DecoderBlock(nn.Module):
 
 
 class TokenEmbed(nn.Module):
-    """Token + learned positional embeddings."""
+    """Token + (optionally) learned positional embeddings.
+
+    ``use_pos=False`` drops the position table entirely — the rope
+    decoder's position signal lives in the attention rotations, not in
+    the residual stream; the three embed entry points keep their
+    signatures so every schedule calls them identically."""
 
     vocab: int
     dim: int
     max_len: int
     dtype: jnp.dtype = jnp.float32
+    use_pos: bool = True
 
     def setup(self):
         self.tok = nn.Embed(self.vocab, self.dim, dtype=self.dtype)
-        self.pos = self.param(
-            "pos_embed",
-            nn.initializers.normal(0.02),
-            (self.max_len, self.dim),
-            jnp.float32,
-        )
+        if self.use_pos:
+            self.pos = self.param(
+                "pos_embed",
+                nn.initializers.normal(0.02),
+                (self.max_len, self.dim),
+                jnp.float32,
+            )
 
     def __call__(self, ids):
         s = ids.shape[1]
-        return self.tok(ids) + self.pos[:s].astype(self.dtype)
+        out = self.tok(ids)
+        if self.use_pos:
+            out = out + self.pos[:s].astype(self.dtype)
+        return out
 
     def embed_at(self, ids_t, index):
         """Embed a single token column at traced position ``index``."""
-        p = lax.dynamic_slice(self.pos, (index, 0), (1, self.dim))
-        return self.tok(ids_t) + p.astype(self.dtype)
+        out = self.tok(ids_t)
+        if self.use_pos:
+            p = lax.dynamic_slice(self.pos, (index, 0), (1, self.dim))
+            out = out + p.astype(self.dtype)
+        return out
 
     def embed_positions(self, ids, pos_ids):
         """Embed with explicit per-row position ids (ragged batches:
         a left-padded row's logical positions start at 0 at its first
         real token, not at buffer column 0)."""
-        return self.tok(ids) + self.pos[jnp.clip(pos_ids, 0)].astype(
-            self.dtype
-        )
+        out = self.tok(ids)
+        if self.use_pos:
+            out = out + self.pos[jnp.clip(pos_ids, 0)].astype(self.dtype)
+        return out
 
 
 class LMHead(nn.Module):
@@ -586,6 +661,7 @@ def transformer_lm(
     moe_experts: int | None = None,
     moe_top_k: int = 1,
     window: int | None = None,
+    pos: str = "learned",
 ) -> TransformerLM:
     """``kv_heads < heads`` builds a grouped-query (GQA) decoder: KV
     caches shrink by ``heads // kv_heads`` (``kv_heads=1`` = MQA), the
@@ -598,6 +674,10 @@ def transformer_lm(
     EP-shardable via ``parallel.expert.place_experts`` — see
     :class:`DecoderBlock` / :class:`adapt_tpu.models.moe.MoEDecoderMlp`.
 
+    ``pos="rope"`` swaps learned positional embeddings for rotary ones
+    (q/k rotate by logical position in every schedule; the cache holds
+    post-rotation K, so all decode paths serve it unchanged).
+
     ``window`` builds a sliding-window (Mistral-style) decoder: each
     position attends only the previous ``window`` positions. Cached
     decode masks the window as a dynamic ``valid_from`` (no kernel
@@ -607,16 +687,21 @@ def transformer_lm(
     """
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
+    if pos not in ("learned", "rope"):
+        raise ValueError(f"pos={pos!r}: expected 'learned' or 'rope'")
+    rope = pos == "rope"
     g = LayerGraph(name)
     prev = g.add(
-        "embed", TokenEmbed(vocab, dim, max_len, dtype=dtype), INPUT
+        "embed",
+        TokenEmbed(vocab, dim, max_len, dtype=dtype, use_pos=not rope),
+        INPUT,
     )
     for i in range(depth):
         prev = g.add(
             f"decoder_block_{i}",
             DecoderBlock(dim, heads, mlp_dim, dtype=dtype,
                          kv_heads=kv_heads, moe_experts=moe_experts,
-                         moe_top_k=moe_top_k, window=window),
+                         moe_top_k=moe_top_k, window=window, rope=rope),
             prev,
         )
     g.add("head", LMHead(vocab, dtype=dtype), prev)
