@@ -105,6 +105,7 @@ def _attn_kernel(
     valid_k,
     has_vf=False,
     has_shift=False,
+    window=None,
 ):
     """Grid = (batch*heads, q_blocks, k_blocks); the k dimension is the
     innermost (sequential) axis, so only ONE (block_q, d) q tile and ONE
@@ -174,6 +175,9 @@ def _attn_kernel(
             )
             shift = shift_ref[0] if has_shift else 0
             s = jnp.where(rows >= cols + shift, s, _NEG_INF)
+            if window is not None:
+                # Sliding band: row i attends cols in (i - window, i].
+                s = jnp.where(cols > rows - window, s, _NEG_INF)
         m = m_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
@@ -184,15 +188,22 @@ def _attn_kernel(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    # K blocks strictly after this q block (causal) or entirely inside the
-    # left padding (vf) contribute nothing — skip their compute entirely
-    # (the DMA still lands, the MXU stays idle).
+    # K blocks strictly after this q block (causal), entirely inside the
+    # left padding (vf), or entirely behind every row's sliding window
+    # contribute nothing — skip their compute entirely (the DMA still
+    # lands, the MXU stays idle).
     live = None
     if causal:
         live = (
             j * block_k + (shift_ref[0] if has_shift else 0)
             <= q_start + block_q - 1
         )
+        if window is not None:
+            # Lowest row's band floor: cols <= q_start - window are dead
+            # for every row in the tile.
+            live = jnp.logical_and(
+                live, (j + 1) * block_k - 1 > q_start - window
+            )
     if has_vf:
         past_pad = (j + 1) * block_k > vf_ref[0]
         live = past_pad if live is None else jnp.logical_and(live, past_pad)
@@ -262,76 +273,72 @@ def flash_attention(
     skipped, a uniform V average when the row shares a k-block with live
     keys (which is also what the oracle emits) — no caller may read
     them; valid rows match the oracle exactly.
+
+    ``window`` (requires ``causal``, no ``causal_shift``) bands the
+    mask Mistral-style — row i attends (i - window, i] — in BOTH
+    directions: the streaming forward and backward mask and
+    compute-skip blocks outside the band, so a long windowed prefill
+    streams O(S*D) instead of materializing O(S^2) scores.
     """
     if prefer not in (None, "pallas", "xla"):
         raise ValueError(
             f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
         )
-    if window is not None:
-        # Sliding-window band mask: oracle-only for the full-sequence
-        # forward today — O(S^2) scores, so LONG windowed prompts should
-        # prefill incrementally instead (the batcher's chunked prefill
-        # runs the BANDED chunk kernel, and windowed DECODE needs no
-        # kernel change at all — the window rides the valid_from mask in
-        # ops/decode_attention). An explicit kernel request can't be
-        # honored and must not silently downgrade.
-        if prefer == "pallas":
-            raise ValueError(
-                "window is not yet supported by the streaming kernel "
-                "(banded variant is a known follow-up); use the oracle "
-                "path or chunked prefill for long windowed sequences"
-            )
-        return attention_reference(
-            q, k, v, causal=causal, valid_from=valid_from, window=window
-        )
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     if prefer is None:
         prefer = "pallas" if scores_over_budget(q.shape, k.shape) else "xla"
     if prefer == "xla":
         return attention_reference(
-            q, k, v, causal=causal, valid_from=valid_from
+            q, k, v, causal=causal, valid_from=valid_from, window=window
         )
     if valid_from is None:
-        return _flash_vjp(q, k, v, causal, block_q, block_k)
+        return _flash_vjp(q, k, v, causal, block_q, block_k, window)
     return _flash_ragged_vjp(
         q, k, v, jnp.asarray(valid_from, jnp.int32), causal, block_q,
-        block_k,
+        block_k, window,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_vjp(q, k, v, causal, block_q, block_k):
-    return _flash_impl(q, k, v, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_vjp(q, k, v, causal, block_q, block_k, window=None):
+    return _flash_impl(q, k, v, causal, block_q, block_k, window=window)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_ragged_vjp(q, k, v, valid_from, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_ragged_vjp(q, k, v, valid_from, causal, block_q, block_k,
+                      window=None):
     """valid_from travels as a regular (traced) operand — custom_vjp
     nondiff_argnums may not hold tracers, and the bwd returns None for
     its (integer, gradient-free) cotangent."""
     return _flash_impl(
-        q, k, v, causal, block_q, block_k, valid_from=valid_from
+        q, k, v, causal, block_q, block_k, valid_from=valid_from,
+        window=window,
     )
 
 
-def _flash_ragged_fwd(q, k, v, valid_from, causal, block_q, block_k):
+def _flash_ragged_fwd(q, k, v, valid_from, causal, block_q, block_k,
+                      window=None):
     if _bwd_streams(q.shape, k.shape, causal, block_q, block_k):
         out, lse = _flash_impl(
             q, k, v, causal, block_q, block_k,
-            with_lse=True, valid_from=valid_from,
+            with_lse=True, valid_from=valid_from, window=window,
         )
         return out, (q, k, v, valid_from, out, lse)
     out = _flash_impl(
-        q, k, v, causal, block_q, block_k, valid_from=valid_from
+        q, k, v, causal, block_q, block_k, valid_from=valid_from,
+        window=window,
     )
     return out, (q, k, v, valid_from, None, None)
 
 
-def _flash_ragged_bwd(causal, block_q, block_k, residuals, do):
+def _flash_ragged_bwd(causal, block_q, block_k, window, residuals, do):
     q, k, v, valid_from, out, lse = residuals
     if out is None:  # materialized-recompute branch (scores fit)
         _, vjp = jax.vjp(
             lambda q_, k_, v_: attention_reference(
-                q_, k_, v_, causal=causal, valid_from=valid_from
+                q_, k_, v_, causal=causal, valid_from=valid_from,
+                window=window,
             ),
             q,
             k,
@@ -341,7 +348,7 @@ def _flash_ragged_bwd(causal, block_q, block_k, residuals, do):
     dq, dk, dv = _flash_bwd_impl(
         q, k, v, out, lse, do,
         causal=causal, block_q=block_q, block_k=block_k,
-        valid_from=valid_from,
+        valid_from=valid_from, window=window,
     )
     return dq, dk, dv, None
 
@@ -401,25 +408,26 @@ def _bwd_streams(q_shape, k_shape, causal, block_q, block_k) -> bool:
     )
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k):
+def _flash_fwd(q, k, v, causal, block_q, block_k, window=None):
     # Save the O(S) logsumexp (and keep `out` alive) only when the
     # backward will actually stream; the oracle branch re-derives
     # everything from (q, k, v).
     if _bwd_streams(q.shape, k.shape, causal, block_q, block_k):
         out, lse = _flash_impl(
-            q, k, v, causal, block_q, block_k, with_lse=True
+            q, k, v, causal, block_q, block_k, with_lse=True,
+            window=window,
         )
         return out, (q, k, v, out, lse)
-    out = _flash_impl(q, k, v, causal, block_q, block_k)
+    out = _flash_impl(q, k, v, causal, block_q, block_k, window=window)
     return out, (q, k, v, None, None)
 
 
-def _flash_bwd(causal, block_q, block_k, residuals, do):
+def _flash_bwd(causal, block_q, block_k, window, residuals, do):
     q, k, v, out, lse = residuals
     if out is None:  # fwd decided on the materialized-recompute branch
         _, vjp = jax.vjp(
             lambda q_, k_, v_: attention_reference(
-                q_, k_, v_, causal=causal
+                q_, k_, v_, causal=causal, window=window
             ),
             q,
             k,
@@ -428,12 +436,13 @@ def _flash_bwd(causal, block_q, block_k, residuals, do):
         return vjp(do)
     return _flash_bwd_impl(
         q, k, v, out, lse, do,
-        causal=causal, block_q=block_q, block_k=block_k,
+        causal=causal, block_q=block_q, block_k=block_k, window=window,
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "with_lse")
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "with_lse", "window"),
 )
 def _flash_impl(
     q: jax.Array,
@@ -445,16 +454,20 @@ def _flash_impl(
     with_lse: bool = False,
     valid_from: jax.Array | None = None,
     causal_shift: jax.Array | None = None,
+    window: int | None = None,
 ):
     if causal_shift is not None and not causal:
         raise ValueError("causal_shift requires causal=True")
+    if window is not None and (not causal or causal_shift is not None):
+        raise ValueError("window requires causal=True without causal_shift")
     if pltpu is None:  # pragma: no cover — jax builds without pallas-tpu
         return (
-            _reference_with_lse(q, k, v, causal, valid_from, causal_shift)
+            _reference_with_lse(q, k, v, causal, valid_from, causal_shift,
+                                window)
             if with_lse
             else attention_reference(
                 q, k, v, causal=causal, valid_from=valid_from,
-                causal_shift=causal_shift,
+                causal_shift=causal_shift, window=window,
             )
         )
     b, h, s_q, d = q.shape
@@ -470,11 +483,12 @@ def _flash_impl(
     pad_k = (-s_k) % block_k
     if causal and pad_k and s_q != s_k:
         return (
-            _reference_with_lse(q, k, v, causal, valid_from, causal_shift)
+            _reference_with_lse(q, k, v, causal, valid_from, causal_shift,
+                                window)
             if with_lse
             else attention_reference(
                 q, k, v, causal=causal, valid_from=valid_from,
-                causal_shift=causal_shift,
+                causal_shift=causal_shift, window=window,
             )
         )
     if pad_q or pad_k:
@@ -497,6 +511,7 @@ def _flash_impl(
         valid_k=s_k,
         has_vf=valid_from is not None,
         has_shift=causal_shift is not None,
+        window=window,
     )
     on_tpu = jax.default_backend() == "tpu"
     scratch = [
@@ -599,6 +614,7 @@ def _reference_with_lse(
     causal: bool,
     valid_from: jax.Array | None = None,
     causal_shift: jax.Array | None = None,
+    window: int | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Oracle-path ``(out, lse)`` computing the score matrix ONCE (the
     fallback exists because scores are expensive to materialize —
@@ -609,6 +625,12 @@ def _reference_with_lse(
     ) / math.sqrt(d)
     if causal:
         s = jnp.where(_causal_mask(*s.shape[-2:], causal_shift), s, _NEG_INF)
+    if window is not None:
+        s_q, s_k = s.shape[-2:]
+        band = (
+            jnp.arange(s_k)[None, :] > jnp.arange(s_q)[:, None] - window
+        )
+        s = jnp.where(band[None, None], s, _NEG_INF)
     if valid_from is not None:
         cols = jnp.arange(s.shape[-1])
         live = cols[None, :] >= valid_from[:, None]
@@ -635,6 +657,7 @@ def _bwd_dq_kernel(
     sm_scale,
     valid_k,
     has_vf=False,
+    window=None,
 ):
     """dQ pass: grid (bh, q_blocks, k_blocks), K/V streaming innermost;
     dq accumulates in VMEM scratch. Scores recompute blockwise against
@@ -677,6 +700,8 @@ def _bwd_dq_kernel(
                 jnp.int32, (block_q, block_k), 0
             )
             s = jnp.where(rows >= cols, s, _NEG_INF)
+            if window is not None:
+                s = jnp.where(cols > rows - window, s, _NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -691,6 +716,10 @@ def _bwd_dq_kernel(
     live = None
     if causal:
         live = j * block_k <= q_start + block_q - 1
+        if window is not None:
+            live = jnp.logical_and(
+                live, (j + 1) * block_k - 1 > q_start - window
+            )
     if has_vf:
         past_pad = (j + 1) * block_k > vf_ref[0]
         live = past_pad if live is None else jnp.logical_and(live, past_pad)
@@ -719,6 +748,7 @@ def _bwd_dkv_kernel(
     valid_k,
     sp_k,
     has_vf=False,
+    window=None,
 ):
     """dK/dV pass: grid (bh, k_blocks, q_blocks), Q/dO streaming
     innermost; dk/dv accumulate in VMEM scratch."""
@@ -762,6 +792,8 @@ def _bwd_dkv_kernel(
                 jnp.int32, (block_q, block_k), 0
             )
             s = jnp.where(rows >= cols, s, _NEG_INF)
+            if window is not None:
+                s = jnp.where(cols > rows - window, s, _NEG_INF)
         p = jnp.exp(s - lse)
         dv_scr[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -781,6 +813,12 @@ def _bwd_dkv_kernel(
     if causal:
         # Q blocks entirely before this K block see none of it.
         live = q_start + block_q - 1 >= k_start
+        if window is not None:
+            # Q blocks entirely past this K block's window: every row i
+            # needs a col c with i < c + window.
+            live = jnp.logical_and(
+                live, q_start < k_start + block_k + window - 1
+            )
     if has_vf:
         # A K block entirely inside the left padding gets zero gradient.
         past_pad = k_start + block_k > vf_ref[0]
@@ -797,10 +835,11 @@ def _bwd_dkv_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k")
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "window")
 )
 def _flash_bwd_impl(
-    q, k, v, out, lse, do, *, causal, block_q, block_k, valid_from=None
+    q, k, v, out, lse, do, *, causal, block_q, block_k, valid_from=None,
+    window=None,
 ):
     """Streaming flash backward: two Pallas passes (dQ, then dK/dV), each
     recomputing score blocks against the saved logsumexp — O(S*D) HBM
@@ -885,6 +924,7 @@ def _flash_bwd_impl(
             sm_scale=sm_scale,
             valid_k=s_k,
             has_vf=valid_from is not None,
+            window=window,
         ),
         grid=(b * h, num_q, num_kv),
         in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec,
@@ -915,6 +955,7 @@ def _flash_bwd_impl(
             valid_k=s_k,
             sp_k=sp_k,
             has_vf=valid_from is not None,
+            window=window,
         ),
         grid=(b * h, num_kv, num_q),
         in_specs=[
