@@ -120,6 +120,28 @@ def _child() -> None:
     cases.append({"case": "paged_chunk_gqa2_pos256", "max_err": cerr,
                   "ok": cerr < 2e-3})
 
+    # Banded streaming flash (windowed prefill at length): the band
+    # mask + two-sided dead-block skip, compiled.
+    from adapt_tpu.ops.attention import (
+        attention_reference,
+        flash_attention,
+    )
+
+    kq3, kk3, kv3 = jax.random.split(jax.random.fold_in(rng, 123), 3)
+    wq = jax.random.normal(kq3, (1, 4, 2048, hd), jnp.float32)
+    wk = jax.random.normal(kk3, (1, 4, 2048, hd), jnp.float32)
+    wv = jax.random.normal(kv3, (1, 4, 2048, hd), jnp.float32)
+    wref = np.asarray(
+        attention_reference(wq, wk, wv, causal=True, window=512)
+    )
+    wout = np.asarray(
+        flash_attention(wq, wk, wv, causal=True, window=512,
+                        prefer="pallas")
+    )
+    werr = float(np.max(np.abs(wout - wref)))
+    cases.append({"case": "banded_flash_2k_win512", "max_err": werr,
+                  "ok": werr < 2e-3})
+
     ok = all(c["ok"] for c in cases)
     print(
         json.dumps(
