@@ -142,25 +142,34 @@ def run_child_json(
     metric: str,
     unit: str,
     timeout_s: float,
+    *,
+    env: dict | None = None,
+    allow_cpu: bool = False,
+    out_path: str | None = None,
 ) -> int:
     """The shared parent half of the subprocess measurement contract
     (bench.py's postmortem rules): run ``cmd``, scan stdout for the first
     parseable '{'-line, reject silent CPU fallbacks inside a TPU
-    measurement, and ALWAYS print exactly one JSON line + return 0 — on
-    failure an error record, never a crash. Drivers that need more than
-    one child mode (artifact writers like mfu_sweep) keep their own
-    loops; every plain one-JSON-line driver should use this."""
+    measurement (unless ``allow_cpu`` — an explicit --cpu validation
+    run), and ALWAYS print exactly one JSON line + return 0 — on
+    failure an error record, never a crash. ``out_path`` additionally
+    APPENDS the record as one JSONL row (append, not overwrite: a relay
+    error stub must land beside earlier measurements, never over them —
+    the r04 lesson). Drivers that need more than one child mode
+    (artifact writers like mfu_sweep) keep their own loops; every plain
+    one-JSON-line driver should use this."""
     import subprocess
 
+    record, err = None, ""
     try:
         proc = subprocess.run(
             cmd,
             capture_output=True,
             text=True,
             timeout=timeout_s,
+            env=env,
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         )
-        record = None
         for ln in proc.stdout.splitlines():
             ln = ln.strip()
             if ln.startswith("{"):
@@ -169,26 +178,26 @@ def run_child_json(
                     break
                 except json.JSONDecodeError:
                     continue  # stray '{'-prefixed noise; keep scanning
-        if proc.returncode == 0 and record is not None:
-            if record.get("platform") == "cpu":
-                err = "TPU run silently fell back to the CPU backend"
-            else:
-                print(json.dumps(record), flush=True)
-                return 0
-        else:
+        if proc.returncode != 0 or record is None:
+            record = None
             err = (proc.stderr or proc.stdout or "").strip()[-300:]
+        elif record.get("platform") == "cpu" and not allow_cpu:
+            record = None
+            err = "TPU run silently fell back to the CPU backend"
     except subprocess.TimeoutExpired:
         err = f"child timed out after {timeout_s:.0f}s (TPU relay hang?)"
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": 0.0,
-                "unit": unit,
-                "vs_baseline": 0.0,
-                "error": err,
-            }
-        ),
-        flush=True,
-    )
+    if record is None:
+        record = {
+            "metric": metric,
+            "value": 0.0,
+            "unit": unit,
+            "vs_baseline": 0.0,
+            "error": err,
+        }
+    if out_path is not None:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "a") as f:
+            json.dump(record, f)
+            f.write("\n")
+    print(json.dumps(record), flush=True)
     return 0

@@ -14,29 +14,31 @@ log() { echo "=== $(date +%H:%M:%S) $*"; }
 
 log "0. decode kernel compiled smoke (parity vs oracle on-chip)"
 timeout 900 python benchmarks/decode_attn_smoke.py \
-  | tail -1 | tee "$OUT/decode_attn_smoke.json"
-grep -q '"vs_baseline": 1.0' "$OUT/decode_attn_smoke.json" || {
+  | tail -1 | tee -a "$OUT/decode_attn_smoke.json"
+# Gate on the LAST row (artifacts append — an old pass must not mask a
+# fresh failure).
+tail -1 "$OUT/decode_attn_smoke.json" | grep -q '"vs_baseline": 1.0' || {
   echo "decode kernel smoke FAILED on-chip; skipping the A/B"; exit 1; }
 
 log "1. decode-attn A/B at 2k context (vs run 1's XLA rows)"
 timeout 1800 python benchmarks/lm_decode.py --prompt 1024 --maxlen 2048 \
   --steps 128 --decode-attn pallas | tail -1 \
-  | tee "$OUT/lm_decode_long_native_pallas.json"
+  | tee -a "$OUT/lm_decode_long_native_pallas.json"
 timeout 1800 python benchmarks/lm_decode.py --prompt 1024 --maxlen 2048 \
   --steps 128 --kv int8 --decode-attn pallas | tail -1 \
-  | tee "$OUT/lm_decode_long_int8_pallas.json"
+  | tee -a "$OUT/lm_decode_long_int8_pallas.json"
 
 log "2. 4k context: cache bytes ~3x weight bytes"
 timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
-  --steps 128 | tail -1 | tee "$OUT/lm_decode_4k_native.json"
+  --steps 128 | tail -1 | tee -a "$OUT/lm_decode_4k_native.json"
 timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
   --steps 128 --decode-attn pallas | tail -1 \
-  | tee "$OUT/lm_decode_4k_native_pallas.json"
+  | tee -a "$OUT/lm_decode_4k_native_pallas.json"
 timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
-  --steps 128 --kv int8 | tail -1 | tee "$OUT/lm_decode_4k_int8.json"
+  --steps 128 --kv int8 | tail -1 | tee -a "$OUT/lm_decode_4k_int8.json"
 timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
   --steps 128 --kv int8 --decode-attn pallas | tail -1 \
-  | tee "$OUT/lm_decode_4k_int8_pallas.json"
+  | tee -a "$OUT/lm_decode_4k_int8_pallas.json"
 
 log "3. continuous batching at serving scale (retry; run 2 hit a relay error)"
 timeout 2700 python benchmarks/continuous_serve.py --slots 8 \
@@ -49,14 +51,18 @@ timeout 2700 python benchmarks/continuous_serve.py --slots 8 \
 
 log "5. MoE decode: 8 experts top-2 at GPT-2 width (single-chip dense-EP)"
 timeout 1800 python benchmarks/lm_decode.py --moe 8 | tail -1 \
-  | tee "$OUT/lm_decode_moe8.json"
+  | tee -a "$OUT/lm_decode_moe8.json"
 
 log "6. sliding-window decode at 4k context (vs step 2's full-attention rows)"
 timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
   --steps 128 --window 1024 | tail -1 \
-  | tee "$OUT/lm_decode_4k_win1024.json"
+  | tee -a "$OUT/lm_decode_4k_win1024.json"
 timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
   --steps 128 --window 1024 --decode-attn pallas | tail -1 \
-  | tee "$OUT/lm_decode_4k_win1024_pallas.json"
+  | tee -a "$OUT/lm_decode_4k_win1024_pallas.json"
+
+log "7. prefill interference: chunked-prefill p99 shield at serving scale"
+timeout 2700 python benchmarks/prefill_interference.py --long 1536 \
+  --chunk 256 | tail -1
 
 log "queue3 done"
