@@ -78,6 +78,13 @@ principle diverge (greedy cannot, short of an exact argmax tie).
 ``_truncate_rows``); ticks with no truncating request skip the filter
 entirely via a static flag.
 
+Request lifecycle niceties: ``submit(stop=[[...], ...])`` ends a stream
+at the first emitted occurrence of any stop token-sequence (host-side
+tail check — the emitted prefix still equals solo ``generate()``), and
+:meth:`cancel` drops a queued request or retires a mid-flight one at
+the next commit boundary with its partial stream as the result (slot
+and pages free immediately after).
+
 Not in scope (v1): cross-chip slots (compose with the pipelined
 decoders for models bigger than one chip).
 """
@@ -113,6 +120,9 @@ class _Request:
     top_p: float  # == 1.0 -> no nucleus truncation
     eos_id: int | None
     folded_keys: np.ndarray  # (steps, 2) uint32 — pre-folded per-step keys
+    #: Host-side stop sequences: the stream ends (inclusive) at the
+    #: first emitted occurrence of any of these token tuples.
+    stop: tuple[tuple[int, ...], ...] = ()
 
 
 @dataclasses.dataclass
@@ -261,6 +271,11 @@ class ContinuousBatcher:
         self._caches = [(one_cache(), one_cache()) for _ in lm.block_names]
         self._queue: collections.deque[_Request] = collections.deque()
         self._done: dict[int, np.ndarray] = {}
+        self._cancelled: set[int] = set()
+        #: req_id the ticking thread popped but has not yet bound to a
+        #: slot — the only window where a live request is in neither
+        #: the queue nor a slot (cancel() must still see it as live).
+        self._admitting: int | None = None
         self._next_id = 0
         self._prefill_cache: dict[int, Any] = {}  # bucket -> jitted fn
         # Instance-lifetime counts (stats() must not read the PROCESS
@@ -491,8 +506,13 @@ class ContinuousBatcher:
         top_p: float | None = None,
         eos_id: int | None = None,
         rng: jax.Array | None = None,
+        stop: list | None = None,
     ) -> int:
-        """Queue one request; returns its id. ``prompt`` is a 1-D token
+        """Queue one request; returns its id. ``stop`` is a list of
+        token-id sequences: the stream ends at the first emitted
+        occurrence of any of them, stop tokens included — host-side
+        truncation, so the emitted prefix still equals solo
+        ``generate()``. ``prompt`` is a 1-D token
         id sequence; ``top_k`` overrides the batcher default for this
         request. The sampling-key schedule matches ``generate`` for a
         solo batch, so outputs are reproducible against it."""
@@ -533,6 +553,8 @@ class ContinuousBatcher:
             )
         if top_p is not None and not (0.0 < top_p <= 1.0):
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if stop is not None and any(len(seq) == 0 for seq in stop):
+            raise ValueError("stop sequences must be non-empty")
         # generate()'s exact schedule: split -> key0 + per-step keys, each
         # folded with the row index (0 — solo semantics). One vmapped
         # dispatch + one host fetch, not O(steps) of them — this runs on
@@ -567,16 +589,56 @@ class ContinuousBatcher:
             top_p=top_p if do_sample and top_p is not None else 1.0,
             eos_id=eos_id,
             folded_keys=folded,
+            stop=tuple(
+                tuple(int(t) for t in seq) for seq in (stop or ())
+            ),
         )
         with self._cv:
             self._queue.append(req)
             self._cv.notify_all()  # wake the server thread, if any
         return req.req_id
 
+    def cancel(self, req_id: int) -> bool:
+        """Cancel a request: queued -> dropped with an empty result;
+        live (in a slot, or mid-admission on the ticking thread) ->
+        retired at the next commit boundary with the partial stream as
+        the result. Returns False only for ids never issued or already
+        finished; True means "cancel accepted" — best-effort if the
+        request finishes concurrently (the stream may complete). The
+        whole decision runs under the handoff lock so it cannot race
+        admission (queue-pop -> slot assignment happens on the ticking
+        thread between lock holds); markers are consumed by _commit /
+        the tick boundary / _finish, never leaked."""
+        with self._cv:
+            if req_id in self._done or not 0 <= req_id < self._next_id:
+                return False
+            for i, req in enumerate(self._queue):
+                if req.req_id == req_id:
+                    del self._queue[i]
+                    self._done[req_id] = np.zeros((0,), np.int32)
+                    self._cv.notify_all()
+                    return True
+            # Live = bound to a slot, or mid-admission on the ticking
+            # thread (popped, not yet slot-bound). Anything else with a
+            # valid id already finished and was claimed.
+            live = req_id == self._admitting or any(
+                s.req is not None and s.req.req_id == req_id
+                for s in self.slots
+            )
+            if not live:
+                return False
+            # Mark it; the ticking thread consumes the marker at its
+            # next boundary.
+            self._cancelled.add(req_id)
+            return True
+
     def _finish(self, slot: _Slot) -> None:
         req = slot.req
         with self._cv:
             self._done[req.req_id] = np.asarray(slot.tokens, np.int32)
+            # Consume any cancel marker that raced a natural finish —
+            # markers must never outlive their request.
+            self._cancelled.discard(req.req_id)
             self._cv.notify_all()  # result() waiters
         self._completed += 1
         global_metrics().inc("continuous.completed")
@@ -589,8 +651,17 @@ class ContinuousBatcher:
             self._pager.free_slot(slot.idx)
 
     def _commit(self, slot: _Slot, token: int) -> None:
-        """Append one emitted token; EOS latches/finishes the request."""
+        """Append one emitted token; EOS, a stop sequence, or a pending
+        cancel latches and finishes the request."""
         req = slot.req
+        with self._cv:
+            cancelled = req.req_id in self._cancelled
+            self._cancelled.discard(req.req_id)
+        if cancelled:
+            # Partial stream becomes the result; the chunk's remaining
+            # tokens for this slot are garbage nobody reads.
+            self._finish(slot)
+            return
         if req.eos_id is not None and token == req.eos_id:
             # generate() pads with EOS forever after; a server frees the
             # slot instead — the emitted stream up to EOS is identical.
@@ -600,6 +671,16 @@ class ContinuousBatcher:
         slot.tokens.append(token)
         slot.emitted += 1
         slot.last_token = token
+        # Host-side stop sequences: purely a stream-tail check — the
+        # emitted stream equals solo generate() truncated at the first
+        # occurrence (inclusive), whatever the stop tokens are.
+        for seq in req.stop:
+            n = len(seq)
+            if n and len(slot.tokens) >= n and tuple(
+                slot.tokens[-n:]
+            ) == seq:
+                self._finish(slot)
+                return
         if slot.emitted >= req.steps:
             self._finish(slot)
 
@@ -611,6 +692,7 @@ class ContinuousBatcher:
                 if not self._queue:
                     continue
                 req = self._queue.popleft()
+                self._admitting = req.req_id  # cancel() sees it as live
             s0 = req.prompt.shape[0]
             bucket = next(b for b in self.prompt_buckets if b >= s0)
             m = 0
@@ -637,6 +719,7 @@ class ContinuousBatcher:
                     self._pager.free_slot(i)  # releases the shares too
                     with self._cv:
                         self._queue.appendleft(req)
+                        self._admitting = None
                     return
             chunked = (
                 self._paged
@@ -725,6 +808,8 @@ class ContinuousBatcher:
             slot.emitted = 0
             slot.tokens = []
             slot.pf_done = m * self._page if chunked else -1
+            with self._cv:
+                self._admitting = None  # slot-bound: visible to cancel()
             self._admitted += 1
             global_metrics().inc("continuous.admitted")
             if not chunked:
@@ -789,6 +874,14 @@ class ContinuousBatcher:
         consumed the decode chunk (0 = no decoding happened this
         tick)."""
         self._admit()
+        for slot in self.slots:
+            if slot.req is None:
+                continue
+            with self._cv:
+                cancelled = slot.req.req_id in self._cancelled
+                self._cancelled.discard(slot.req.req_id)
+            if cancelled:  # mid-prefill or between chunks
+                self._finish(slot)
         for slot in self.slots:
             if slot.req is not None and slot.pf_done >= 0:
                 self._prefill_step(slot)  # interleaves with decode below

@@ -299,3 +299,58 @@ def test_gqa_requests_match_generate():
     for rid, i in ids.items():
         want = _solo(lm, variables, prompts[i], steps[i])
         np.testing.assert_array_equal(out[rid], want, err_msg=f"req {i}")
+
+
+def test_stop_sequences_truncate_at_first_match(lm_setup):
+    """A stop sequence ends the stream at its first occurrence
+    (inclusive); the emitted prefix equals solo generate()'s prefix."""
+    lm, variables = lm_setup
+    p = np.asarray([1, 2, 3], np.int32)
+    full = _solo(lm, variables, p, 12)
+    # Pick the stop sequence FROM the greedy stream so it must trigger.
+    stop_seq = [int(full[4]), int(full[5])]
+    bat = ContinuousBatcher(lm, variables, slots=2)
+    rid = bat.submit(p, 12, stop=[stop_seq, [999]])
+    out = bat.run()
+    got = out[rid]
+    assert list(got[-2:]) == stop_seq
+    np.testing.assert_array_equal(got, full[: len(got)])
+    assert len(got) <= 6  # ended at (or before) the planted match
+    # A stop sequence that CANNOT occur (ids are always < vocab)
+    # changes nothing — asserted unconditionally.
+    rid2 = bat.submit(p, 12, stop=[[lm.vocab]])
+    out2 = bat.run()
+    np.testing.assert_array_equal(out2[rid2], full)
+
+
+def test_cancel_queued_and_midflight(lm_setup):
+    lm, variables = lm_setup
+    p1 = np.asarray([4, 5, 6, 7], np.int32)
+    p2 = np.asarray([8, 9], np.int32)
+    bat = ContinuousBatcher(lm, variables, slots=1, chunk=2)
+    r1 = bat.submit(p1, 30)
+    r2 = bat.submit(p2, 5)  # waits in queue (1 slot)
+    bat.tick()
+    assert bat.cancel(r2)  # still queued -> dropped, empty result
+    bat.tick()
+    assert bat.cancel(r1)  # mid-flight -> partial stream
+    assert not bat.cancel(12345)  # unknown id
+    out = bat.run()
+    assert out[r2].shape == (0,)
+    partial = out[r1]
+    assert 0 < len(partial) < 30
+    np.testing.assert_array_equal(
+        partial, _solo(lm, variables, p1, 30)[: len(partial)]
+    )
+    assert bat.stats()["active"] == 0
+    assert not bat._cancelled  # no leaked cancel markers
+
+
+def test_cancel_finished_request_returns_false(lm_setup):
+    lm, variables = lm_setup
+    p = np.asarray([1, 2], np.int32)
+    bat = ContinuousBatcher(lm, variables, slots=1)
+    rid = bat.submit(p, 3)
+    out = bat.run()
+    assert len(out[rid]) == 3
+    assert not bat.cancel(rid)
