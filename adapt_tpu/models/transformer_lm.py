@@ -869,7 +869,8 @@ def generate(
     prompt_lengths: jax.Array | None = None,
     kv_cache_dtype: str = "native",
     decode_attn: str | None = None,
-) -> jax.Array:
+    return_logprobs: bool = False,
+) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Generation as one compiled program: prefill over the prompt + a
     ``lax.scan`` of single-token cached decode steps.
 
@@ -907,6 +908,12 @@ def generate(
     ``decode_attn`` picks the per-step attention implementation (None =
     measured auto, ``"xla"``, ``"pallas"`` — see
     :mod:`adapt_tpu.ops.decode_attention`).
+
+    ``return_logprobs=True`` returns ``(tokens, logprobs)`` where
+    ``logprobs[b, t]`` is the MODEL's log-probability (log-softmax of
+    the raw, pre-temperature logits) of the emitted token — the serving
+    convention: sampling knobs shape which token gets picked, the
+    reported score is always the model's own.
     """
     lengths, rng, do_sample = validate_generate_args(
         lm, prompt, steps, temperature, top_k, rng, prompt_lengths,
@@ -935,6 +942,7 @@ def generate(
         ragged=prompt_lengths is not None,
         kv_quant=kv_cache_dtype == "int8",
         decode_attn=decode_attn,
+        return_logprobs=return_logprobs,
     )
 
 
@@ -942,7 +950,7 @@ def generate(
     jax.jit,
     static_argnames=(
         "lm", "steps", "do_sample", "top_k", "use_top_p", "use_eos",
-        "ragged", "kv_quant", "decode_attn",
+        "ragged", "kv_quant", "decode_attn", "return_logprobs",
     ),
 )
 def _generate_impl(
@@ -963,7 +971,8 @@ def _generate_impl(
     ragged: bool,
     kv_quant: bool,
     decode_attn: str | None = None,
-) -> jax.Array:
+    return_logprobs: bool = False,
+) -> jax.Array | tuple[jax.Array, jax.Array]:
     g = lm.graph
     b, s0 = prompt.shape
     embed = g.node("embed").module
@@ -1007,6 +1016,18 @@ def _generate_impl(
     first = pick(logits[:, 0], key0).astype(prompt.dtype)  # (b,)
     done0 = (first == eos_id) if use_eos else jnp.zeros((b,), bool)
 
+    def chosen_logprob(lg, tok):
+        """Model logprob (log-softmax of RAW logits) of the emitted
+        token — sampling knobs pick, the model scores."""
+        lp = jax.nn.log_softmax(lg, axis=-1)
+        return jnp.take_along_axis(
+            lp, tok[:, None].astype(jnp.int32), axis=-1
+        )[:, 0]
+
+    first_lp = (
+        chosen_logprob(logits[:, 0], first) if return_logprobs else None
+    )
+
     # ---- decode ----------------------------------------------------------
     # Each iteration consumes the carried token and emits its successor,
     # so steps-1 iterations (plus the prefill's `first`) produce exactly
@@ -1044,7 +1065,10 @@ def _generate_impl(
         if use_eos:
             nxt = jnp.where(done, eos_id.astype(tok.dtype), nxt)
             done = done | (nxt == eos_id)
-        return (nxt, index + 1, done, tuple(new_caches)), nxt
+        out = (
+            (nxt, chosen_logprob(lg, nxt)) if return_logprobs else nxt
+        )
+        return (nxt, index + 1, done, tuple(new_caches)), out
 
     (_, _, _, _), rest = lax.scan(
         step,
@@ -1053,6 +1077,15 @@ def _generate_impl(
             (0, 2), jnp.uint32
         ),
     )
+    if return_logprobs:
+        rest_tok, rest_lp = rest
+        tokens = jnp.concatenate(
+            [first[:, None], jnp.swapaxes(rest_tok, 0, 1)], axis=1
+        )
+        lps = jnp.concatenate(
+            [first_lp[:, None], jnp.swapaxes(rest_lp, 0, 1)], axis=1
+        )
+        return tokens, lps  # (b, steps) each
     return jnp.concatenate(
         [first[:, None], jnp.swapaxes(rest, 0, 1)], axis=1
     )  # (b, steps)

@@ -615,6 +615,10 @@ class ContinuousBatcher:
             for i, req in enumerate(self._queue):
                 if req.req_id == req_id:
                     del self._queue[i]
+                    # A marker from an earlier cancel of this id (e.g.
+                    # while it was mid-admission before being re-queued
+                    # on pool pressure) must not outlive it.
+                    self._cancelled.discard(req_id)
                     self._done[req_id] = np.zeros((0,), np.int32)
                     self._cv.notify_all()
                     return True

@@ -609,3 +609,56 @@ def test_lm_gqa_validation():
     v1 = mha.graph.init(jax.random.PRNGKey(7), ids)
     v2 = explicit.graph.init(jax.random.PRNGKey(7), ids)
     assert jax.tree.structure(v1) == jax.tree.structure(v2)
+
+
+def test_generate_logprobs_match_full_forward():
+    """return_logprobs: the reported score of each emitted token equals
+    log_softmax of the full causal forward's logits at that position —
+    and tokens are unchanged vs the plain call."""
+    from adapt_tpu.models.transformer_lm import (
+        generate, lm_tiny, logits_full,
+    )
+
+    lm = lm_tiny(vocab=37, max_len=32)
+    variables = lm.graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 37)
+    plain = np.asarray(generate(lm, variables, prompt, 6))
+    toks, lps = generate(lm, variables, prompt, 6, return_logprobs=True)
+    toks, lps = np.asarray(toks), np.asarray(lps)
+    np.testing.assert_array_equal(toks, plain)
+    assert lps.shape == (2, 6) and (lps <= 0).all()
+    ids = np.concatenate([np.asarray(prompt), toks], axis=1)
+    for t in range(6):
+        lg = logits_full(lm, variables, jnp.asarray(ids[:, : 5 + t]))[:, -1]
+        want = np.asarray(jax.nn.log_softmax(lg, axis=-1))
+        got_tok = toks[:, t]
+        np.testing.assert_allclose(
+            lps[:, t], want[np.arange(2), got_tok], rtol=2e-4, atol=2e-4,
+            err_msg=f"step {t}",
+        )
+
+
+def test_generate_logprobs_sampled_score_is_models_own():
+    """Sampled generation with temperature/top-k still reports the RAW
+    model log-softmax of the chosen token (not the tempered/filtered
+    distribution)."""
+    from adapt_tpu.models.transformer_lm import generate, lm_tiny
+
+    lm = lm_tiny(vocab=37, max_len=32)
+    variables = lm.graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 0, 37)
+    toks, lps = generate(
+        lm, variables, prompt, 5, temperature=1.3, top_k=5,
+        rng=jax.random.PRNGKey(3), return_logprobs=True,
+    )
+    plain = generate(
+        lm, variables, prompt, 5, temperature=1.3, top_k=5,
+        rng=jax.random.PRNGKey(3),
+    )
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(plain))
+    lps = np.asarray(lps)
+    assert (lps <= 0).all() and np.isfinite(lps).all()
