@@ -95,6 +95,7 @@ import collections
 import dataclasses
 import threading
 from functools import partial
+from collections.abc import Callable
 from typing import Any
 
 import jax
@@ -123,6 +124,8 @@ class _Request:
     #: Host-side stop sequences: the stream ends (inclusive) at the
     #: first emitted occurrence of any of these token tuples.
     stop: tuple[tuple[int, ...], ...] = ()
+    #: Optional streaming callback (req_id, token, index) per commit.
+    on_token: Callable[[int, int, int], None] | None = None
 
 
 @dataclasses.dataclass
@@ -290,6 +293,9 @@ class ContinuousBatcher:
         self._cv = threading.Condition()
         self._server: threading.Thread | None = None
         self._stopping = False
+        #: Exception that killed the server thread's tick (re-raised to
+        #: result() waiters instead of a misleading timeout).
+        self._server_error: BaseException | None = None
 
     # -- compiled pieces ---------------------------------------------------
 
@@ -507,8 +513,17 @@ class ContinuousBatcher:
         eos_id: int | None = None,
         rng: jax.Array | None = None,
         stop: list | None = None,
+        on_token: Callable[[int, int, int], None] | None = None,
     ) -> int:
-        """Queue one request; returns its id. ``stop`` is a list of
+        """Queue one request; returns its id. ``on_token`` (optional
+        ``callable(req_id, token, index)``) streams each committed
+        token as it lands — invoked on the TICKING thread at commit
+        time (chunk granularity: up to ``chunk`` callbacks per tick),
+        so keep it cheap and thread-safe. Exceptions poison the tick:
+        synchronous drivers see them directly; under :meth:`start` the
+        server stops and every ``result()`` waiter re-raises the
+        callback's exception (never a silent timeout).
+        ``stop`` is a list of
         token-id sequences: the stream ends at the first emitted
         occurrence of any of them, stop tokens included — host-side
         truncation, so the emitted prefix still equals solo
@@ -592,6 +607,7 @@ class ContinuousBatcher:
             stop=tuple(
                 tuple(int(t) for t in seq) for seq in (stop or ())
             ),
+            on_token=on_token,
         )
         with self._cv:
             self._queue.append(req)
@@ -666,13 +682,14 @@ class ContinuousBatcher:
             # tokens for this slot are garbage nobody reads.
             self._finish(slot)
             return
+        slot.tokens.append(token)
+        if req.on_token is not None:
+            req.on_token(req.req_id, token, len(slot.tokens) - 1)
         if req.eos_id is not None and token == req.eos_id:
             # generate() pads with EOS forever after; a server frees the
             # slot instead — the emitted stream up to EOS is identical.
-            slot.tokens.append(token)
             self._finish(slot)
             return
-        slot.tokens.append(token)
         slot.emitted += 1
         slot.last_token = token
         # Host-side stop sequences: purely a stream-tail check — the
@@ -1063,7 +1080,19 @@ class ContinuousBatcher:
                         self._cv.wait(timeout=0.1)
                     if self._stopping:
                         return
-                self.tick()
+                try:
+                    self.tick()
+                except BaseException as e:  # noqa: BLE001 — re-raised
+                    # A tick exception (e.g. from a user's on_token
+                    # callback) must not strand result() waiters in a
+                    # silent 300s timeout: stash it, stop, wake them —
+                    # they re-raise it with provenance.
+                    with self._cv:
+                        self._server_error = e
+                        self._stopping = True
+                        self._cv.notify_all()
+                    log.error("server tick failed: %r", e)
+                    return
                 with self._cv:
                     self._cv.notify_all()  # results may have landed
 
@@ -1112,5 +1141,9 @@ class ContinuousBatcher:
                     f"request {req_id} not done within {timeout}s"
                 )
             if req_id not in self._done:
+                if self._server_error is not None:
+                    raise RuntimeError(
+                        "batcher server thread died mid-tick"
+                    ) from self._server_error
                 raise RuntimeError("batcher stopped before completion")
             return self._done.pop(req_id)

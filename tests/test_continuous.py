@@ -354,3 +354,52 @@ def test_cancel_finished_request_returns_false(lm_setup):
     out = bat.run()
     assert len(out[rid]) == 3
     assert not bat.cancel(rid)
+
+
+def test_on_token_streams_every_committed_token(lm_setup):
+    """The streaming callback sees exactly the final stream, in order,
+    with correct indices — including the EOS token and across requests
+    interleaved in one batcher."""
+    lm, variables = lm_setup
+    p1 = np.asarray([1, 2, 3], np.int32)
+    p2 = np.asarray([4, 5], np.int32)
+    streamed = {1: [], 2: []}
+
+    def cb(tag):
+        def on_token(rid, tok, idx):
+            assert idx == len(streamed[tag])
+            streamed[tag].append(tok)
+        return on_token
+
+    bat = ContinuousBatcher(lm, variables, slots=2, chunk=2)
+    full1 = _solo(lm, variables, p1, 8)
+    r1 = bat.submit(p1, 8, on_token=cb(1))
+    r2 = bat.submit(p2, 6, eos_id=int(_solo(lm, variables, p2, 6)[3]),
+                    on_token=cb(2))
+    out = bat.run()
+    np.testing.assert_array_equal(np.asarray(streamed[1]), out[r1])
+    np.testing.assert_array_equal(np.asarray(streamed[2]), out[r2])
+    np.testing.assert_array_equal(out[r1], full1)
+    assert streamed[2][-1] == out[r2][-1]  # EOS streamed too
+
+
+def test_on_token_exception_surfaces_to_result_waiters(lm_setup):
+    """A raising callback in threaded mode must not strand result()
+    waiters in a timeout: the server stops and result() re-raises."""
+    lm, variables = lm_setup
+
+    def bad(rid, tok, idx):
+        raise RuntimeError("boom-in-callback")
+
+    bat = ContinuousBatcher(lm, variables, slots=1)
+    bat.start()
+    try:
+        rid = bat.submit(np.asarray([1, 2], np.int32), 4, on_token=bad)
+        with pytest.raises(RuntimeError) as ei:
+            bat.result(rid, timeout=60.0)
+        assert "boom-in-callback" in repr(ei.value.__cause__)
+    finally:
+        bat._stopping = True  # thread already dead; stop() would join it
+        with bat._cv:
+            bat._cv.notify_all()
+        bat._server = None
