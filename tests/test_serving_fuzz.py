@@ -69,7 +69,7 @@ def _random_batcher(rs, lm, variables):
                              **kw), desc
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6, 7])
 def test_serving_fuzz_streams_match_solo(seed):
     rs = np.random.RandomState(seed)
     lm, variables, mdesc = _random_model(rs)
