@@ -45,6 +45,17 @@ from adapt_tpu.ops.quantize import quantize_kv_vectors
 _NEG_INF = -1e30
 
 
+def chosen_logprob(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """THE emitted-token score convention, shared by ``generate`` and
+    the continuous batcher (one definition — the parity tests assert
+    they agree): log-softmax of the RAW pre-temperature logits at the
+    chosen token. logits (n, V), tokens (n,) -> (n,) f32."""
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(
+        lp, tokens[:, None].astype(jnp.int32), axis=-1
+    )[:, 0]
+
+
 def apply_rope(x: jax.Array, positions: jax.Array,
                base: float = 10000.0) -> jax.Array:
     """Rotary position embedding over (b, heads, s, head_dim) with
@@ -1015,14 +1026,6 @@ def _generate_impl(
     rng, key0 = jax.random.split(rng)
     first = pick(logits[:, 0], key0).astype(prompt.dtype)  # (b,)
     done0 = (first == eos_id) if use_eos else jnp.zeros((b,), bool)
-
-    def chosen_logprob(lg, tok):
-        """Model logprob (log-softmax of RAW logits) of the emitted
-        token — sampling knobs pick, the model scores."""
-        lp = jax.nn.log_softmax(lg, axis=-1)
-        return jnp.take_along_axis(
-            lp, tok[:, None].astype(jnp.int32), axis=-1
-        )[:, 0]
 
     first_lp = (
         chosen_logprob(logits[:, 0], first) if return_logprobs else None

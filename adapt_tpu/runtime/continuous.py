@@ -103,7 +103,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from adapt_tpu.models.transformer_lm import TransformerLM, nucleus_filter
+from adapt_tpu.models.transformer_lm import (
+    TransformerLM,
+    chosen_logprob,
+    nucleus_filter,
+)
 from adapt_tpu.runtime.paged import Pager, insert_prefill_pages
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
@@ -143,6 +147,7 @@ class _Slot:
     emitted: int = 0
     last_token: int = 0
     tokens: list = dataclasses.field(default_factory=list)
+    lps: list = dataclasses.field(default_factory=list)
 
 
 class ContinuousBatcher:
@@ -153,6 +158,9 @@ class ContinuousBatcher:
     truncation: ``_truncate_rows``). Drive it with :meth:`submit` +
     :meth:`run` (or :meth:`tick` for manual control).
     """
+
+    #: Max UNCLAIMED logprob streams retained (oldest evicted past it).
+    _LPS_CAP = 4096
 
     def __init__(
         self,
@@ -274,6 +282,12 @@ class ContinuousBatcher:
         self._caches = [(one_cache(), one_cache()) for _ in lm.block_names]
         self._queue: collections.deque[_Request] = collections.deque()
         self._done: dict[int, np.ndarray] = {}
+        #: Per-request logprob streams, claimable via logprobs() after
+        #: the tokens are fetched. BOUNDED: callers that never claim
+        #: them (the common tokens-only usage) must not leak — beyond
+        #: _LPS_CAP unclaimed entries the oldest are evicted
+        #: (insertion-ordered dict).
+        self._done_lps: dict[int, np.ndarray] = {}
         self._cancelled: set[int] = set()
         #: req_id the ticking thread popped but has not yet bound to a
         #: slot — the only window where a live request is in neither
@@ -374,12 +388,15 @@ class ContinuousBatcher:
             nxt = jnp.where(greedy, pick_greedy, pick_sampled).astype(
                 tokens.dtype
             )
-            return (nxt, pos + 1, tuple(new_caches)), nxt
+            # One cheap (B, V) reduction per step, always emitted;
+            # chosen_logprob is THE shared scoring convention.
+            lp = chosen_logprob(logits, nxt)
+            return (nxt, pos + 1, tuple(new_caches)), (nxt, lp)
 
-        (_, _, caches), toks = lax.scan(
+        (_, _, caches), (toks, lps) = lax.scan(
             body, (tokens, pos, tuple(caches)), keys
         )
-        return toks, list(caches)
+        return toks, lps, list(caches)
 
     def _insert_paged(self, caches, pages, kvs):
         """Scatter a prefilled request's per-block K/V into its pages
@@ -405,7 +422,8 @@ class ContinuousBatcher:
         if nucleus:
             lg = nucleus_filter(lg, top_p[None])
         sampled = jax.vmap(jax.random.categorical)(keys, lg)
-        return jnp.where(greedy, pick_greedy, sampled)
+        first = jnp.where(greedy, pick_greedy, sampled)
+        return first, chosen_logprob(logits, first)
 
     def _prefill_fn(self, bucket: int):
         """Jitted prefill for one prompt bucket: full causal forward over
@@ -426,11 +444,11 @@ class ContinuousBatcher:
                 )
                 kvs.append((ck, cv))
             h_last = lax.dynamic_index_in_dim(h, true_len - 1, 1)
-            first = self._first_pick(
+            first, first_lp = self._first_pick(
                 h_last, variables, keys, temp, top_k, top_p, greedy,
                 truncate, nucleus,
             )
-            return first, kvs
+            return first, first_lp, kvs
 
         self._prefill_cache[bucket] = prefill
         return prefill
@@ -474,13 +492,14 @@ class ContinuousBatcher:
                 )
                 new_caches.append((kp, vp))
             if not sample:  # mid-prefill pass: no token yet
-                return jnp.zeros((1,), jnp.int32), new_caches
+                return (jnp.zeros((1,), jnp.int32),
+                        jnp.zeros((1,), jnp.float32), new_caches)
             h_last = lax.dynamic_index_in_dim(h, true_len - 1, 1)
-            first = self._first_pick(
+            first, first_lp = self._first_pick(
                 h_last, variables, keys, temp, top_k, top_p, greedy,
                 truncate, nucleus,
             )
-            return first, new_caches
+            return first, first_lp, new_caches
 
         self._prefill_cache[key] = prefill
         return prefill
@@ -636,6 +655,7 @@ class ContinuousBatcher:
                     # on pool pressure) must not outlive it.
                     self._cancelled.discard(req_id)
                     self._done[req_id] = np.zeros((0,), np.int32)
+                    self._done_lps[req_id] = np.zeros((0,), np.float32)
                     self._cv.notify_all()
                     return True
             # Live = bound to a slot, or mid-admission on the ticking
@@ -656,6 +676,9 @@ class ContinuousBatcher:
         req = slot.req
         with self._cv:
             self._done[req.req_id] = np.asarray(slot.tokens, np.int32)
+            self._done_lps[req.req_id] = np.asarray(slot.lps, np.float32)
+            while len(self._done_lps) > self._LPS_CAP:
+                self._done_lps.pop(next(iter(self._done_lps)))
             # Consume any cancel marker that raced a natural finish —
             # markers must never outlive their request.
             self._cancelled.discard(req.req_id)
@@ -664,13 +687,14 @@ class ContinuousBatcher:
         global_metrics().inc("continuous.completed")
         slot.req = None
         slot.tokens = []
+        slot.lps = []
         slot.pf_done = -1
         if self._paged:
             # Pages return to the pool the moment the request retires —
             # the capacity win continuous paging exists for.
             self._pager.free_slot(slot.idx)
 
-    def _commit(self, slot: _Slot, token: int) -> None:
+    def _commit(self, slot: _Slot, token: int, lp: float) -> None:
         """Append one emitted token; EOS, a stop sequence, or a pending
         cancel latches and finishes the request."""
         req = slot.req
@@ -683,6 +707,7 @@ class ContinuousBatcher:
             self._finish(slot)
             return
         slot.tokens.append(token)
+        slot.lps.append(lp)
         if req.on_token is not None:
             req.on_token(req.req_id, token, len(slot.tokens) - 1)
         if req.eos_id is not None and token == req.eos_id:
@@ -768,7 +793,7 @@ class ContinuousBatcher:
                 assert n_strip <= len(owned)
                 ids = np.zeros((1, sbucket), np.int32)
                 ids[0, :slen] = req.prompt[m * self._page:]
-                first, self._caches = self._prefill_suffix_fn(
+                first, first_lp, self._caches = self._prefill_suffix_fn(
                     sbucket, n_strip
                 )(
                     self.variables,
@@ -788,7 +813,7 @@ class ContinuousBatcher:
             else:
                 ids = np.zeros((1, bucket), np.int32)
                 ids[0, :s0] = req.prompt
-                first, kvs = self._prefill_fn(bucket)(
+                first, first_lp, kvs = self._prefill_fn(bucket)(
                     self.variables,
                     jnp.asarray(ids),
                     jnp.asarray(s0, jnp.int32),
@@ -828,13 +853,14 @@ class ContinuousBatcher:
             slot.pos = s0
             slot.emitted = 0
             slot.tokens = []
+            slot.lps = []
             slot.pf_done = m * self._page if chunked else -1
             with self._cv:
                 self._admitting = None  # slot-bound: visible to cancel()
             self._admitted += 1
             global_metrics().inc("continuous.admitted")
             if not chunked:
-                self._commit(slot, int(first[0]))
+                self._commit(slot, int(first[0]), float(first_lp[0]))
 
     def _prefill_step(self, slot: _Slot) -> None:
         """One chunked-prefill pass for ``slot``: write positions
@@ -859,7 +885,7 @@ class ContinuousBatcher:
         pages = owned[:n_strip] + [0] * (n_pad - n_strip)
         ids = np.zeros((1, cbucket), np.int32)
         ids[0, :clen] = req.prompt[pos0:pos0 + clen]
-        first, self._caches = self._prefill_suffix_fn(
+        first, first_lp, self._caches = self._prefill_suffix_fn(
             cbucket, n_pad, sample=final
         )(
             self.variables,
@@ -885,7 +911,7 @@ class ContinuousBatcher:
                     owned[j], Pager.prefix_key(req.prompt, (j + 1) * P)
                 )
             slot.pf_done = -1
-            self._commit(slot, int(first[0]))
+            self._commit(slot, int(first[0]), float(first_lp[0]))
 
     def tick(self) -> int:
         """Admit waiting requests into free slots, run ONE prefill chunk
@@ -960,7 +986,7 @@ class ContinuousBatcher:
             top_ks[i] = slot.req.top_k
             top_ps[i] = slot.req.top_p
             greedy[i] = slot.req.temperature == 0.0
-        toks, self._caches = self._step_chunk(
+        toks, lps, self._caches = self._step_chunk(
             self.variables,
             self._caches,
             jnp.asarray(tokens),
@@ -976,13 +1002,15 @@ class ContinuousBatcher:
         )
         self._ticks += 1
         global_metrics().inc("continuous.ticks")
-        toks = np.asarray(toks)  # (C, B) — the chunk's ONE host sync
+        # The chunk's ONE host sync fetches both arrays together.
+        toks, lps = jax.device_get((toks, lps))
+        toks, lps = np.asarray(toks), np.asarray(lps)
         for i, slot in enumerate(self.slots):
             if slot.req is None or slot.pf_done >= 0:
                 continue
             req = slot.req
             for j in range(C):
-                self._commit(slot, int(toks[j, i]))
+                self._commit(slot, int(toks[j, i]), float(lps[j, i]))
                 if slot.req is not req:  # finished (steps or EOS)
                     break
             if slot.req is req:
@@ -1039,6 +1067,20 @@ class ContinuousBatcher:
             out["prefix_hits"] = ps.prefix_hits
             out["prefix_misses"] = ps.prefix_misses
         return out
+
+    def logprobs(self, req_id: int) -> np.ndarray:
+        """Per-token model logprobs of a FINISHED request's stream —
+        the same raw-log-softmax convention as
+        ``generate(return_logprobs=True)``, recorded for every request
+        (the reduction is one cheap (B, V) take per step). Claims them;
+        fetch after :meth:`run` / :meth:`result`."""
+        with self._cv:
+            if req_id not in self._done_lps:
+                raise KeyError(
+                    f"no logprobs for request {req_id} "
+                    "(not finished, or already claimed)"
+                )
+            return self._done_lps.pop(req_id)
 
     def run(self, max_ticks: int = 100_000) -> dict[int, np.ndarray]:
         """Tick until every submitted request completed; returns

@@ -403,3 +403,33 @@ def test_on_token_exception_surfaces_to_result_waiters(lm_setup):
         with bat._cv:
             bat._cv.notify_all()
         bat._server = None
+
+
+def test_batcher_logprobs_match_generate(lm_setup):
+    """Served logprobs equal generate(return_logprobs=True)'s for the
+    same request — greedy and sampled, including the prefill-sampled
+    first token."""
+    lm, variables = lm_setup
+    p1 = np.asarray([1, 2, 3], np.int32)
+    p2 = np.asarray([4, 5, 6, 7], np.int32)
+    bat = ContinuousBatcher(lm, variables, slots=2)
+    r1 = bat.submit(p1, 6)
+    r2 = bat.submit(p2, 5, temperature=0.9, top_k=5,
+                    rng=jax.random.PRNGKey(7))
+    out = bat.run()
+    for rid, p, steps, kw in (
+        (r1, p1, 6, {}),
+        (r2, p2, 5, dict(temperature=0.9, top_k=5,
+                         rng=jax.random.PRNGKey(7))),
+    ):
+        want_t, want_lp = generate(
+            lm, variables, jnp.asarray(p)[None], steps,
+            return_logprobs=True, **kw,
+        )
+        np.testing.assert_array_equal(out[rid], np.asarray(want_t)[0])
+        np.testing.assert_allclose(
+            bat.logprobs(rid), np.asarray(want_lp)[0],
+            rtol=2e-4, atol=2e-4,
+        )
+    with pytest.raises(KeyError):
+        bat.logprobs(r1)  # already claimed
