@@ -128,7 +128,44 @@ def main() -> int:
     batch = int_flag(sys.argv, "--batch", BATCH)
     stem = str_flag(sys.argv, "--stem", "conv7", choices=("conv7", "s2d"))
     notes: list[str] = []
-    for platform, iters, trials, timeout_s, backoff_s in ATTEMPTS:
+    attempts = ATTEMPTS
+    # Fast relay probe: with the relay DOWN, backend init HANGS, so each
+    # TPU attempt would burn its full child timeout — three of them plus
+    # backoffs is ~40 min, past some driver timeouts (r03's BENCH was
+    # rc=124 exactly this way). One cheap probe (own subprocess, own
+    # timeout) collapses the dead-relay schedule to a single short TPU
+    # shot + the CPU evidence-of-life row, keeping the healthy-relay
+    # schedule (and its numbers) untouched.
+    # Only a probe HANG collapses the schedule: a fast-failing relay
+    # (rc!=0 in seconds) costs the retry loop almost nothing and is
+    # exactly the transient mode the backoff retries exist to ride out.
+    # Probe output goes to a real file, not pipes — after a timeout,
+    # subprocess.run would block draining inherited pipe fds to EOF
+    # (the documented gotcha), turning the guard itself into a hang.
+    import tempfile
+
+    with tempfile.TemporaryFile() as probe_err:
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                stdout=subprocess.DEVNULL,
+                stderr=probe_err,
+                timeout=120,
+                start_new_session=True,
+            )
+            probe_hung = False
+            if probe.returncode != 0:
+                probe_err.seek(0)
+                tail = probe_err.read()[-200:].decode(errors="replace")
+                notes.append(
+                    f"relay probe rc={probe.returncode}: {tail.strip()}"
+                )
+        except subprocess.TimeoutExpired:
+            probe_hung = True
+    if probe_hung:
+        notes.append("relay probe HUNG (120s); shortened TPU schedule")
+        attempts = [("tpu", 100, 3, 300, 0), ("cpu", 3, 2, 600, 0)]
+    for platform, iters, trials, timeout_s, backoff_s in attempts:
         if backoff_s:
             time.sleep(backoff_s)
         env = dict(os.environ)
