@@ -683,16 +683,20 @@ class ContinuousBatcher:
             # markers must never outlive their request.
             self._cancelled.discard(req.req_id)
             self._cv.notify_all()  # result() waiters
-        self._completed += 1
+            # Slot retirement + lifetime counters stay inside the lock so
+            # stats() can't observe "finished but still counted active"
+            # (the torn triple an unlocked _completed/slot.req allowed).
+            self._completed += 1
+            slot.req = None
+            slot.tokens = []
+            slot.lps = []
+            slot.pf_done = -1
+            if self._paged:
+                # Pages return to the pool the moment the request
+                # retires — the capacity win continuous paging exists
+                # for.
+                self._pager.free_slot(slot.idx)
         global_metrics().inc("continuous.completed")
-        slot.req = None
-        slot.tokens = []
-        slot.lps = []
-        slot.pf_done = -1
-        if self._paged:
-            # Pages return to the pool the moment the request retires —
-            # the capacity win continuous paging exists for.
-            self._pager.free_slot(slot.idx)
 
     def _commit(self, slot: _Slot, token: int, lp: float) -> None:
         """Append one emitted token; EOS, a stop sequence, or a pending
@@ -857,7 +861,7 @@ class ContinuousBatcher:
             slot.pf_done = m * self._page if chunked else -1
             with self._cv:
                 self._admitting = None  # slot-bound: visible to cancel()
-            self._admitted += 1
+                self._admitted += 1
             global_metrics().inc("continuous.admitted")
             if not chunked:
                 self._commit(slot, int(first[0]), float(first_lp[0]))
@@ -1000,7 +1004,8 @@ class ContinuousBatcher:
             truncate=bool((top_ks < self.lm.vocab).any()),
             nucleus=bool((top_ps < 1.0).any()),
         )
-        self._ticks += 1
+        with self._cv:
+            self._ticks += 1
         global_metrics().inc("continuous.ticks")
         # The chunk's ONE host sync fetches both arrays together.
         toks, lps = jax.device_get((toks, lps))
@@ -1043,29 +1048,34 @@ class ContinuousBatcher:
         and THIS batcher's lifetime admit/complete/tick counts
         (instance-scoped — mirror counters also land in
         ``utils.metrics.global_metrics`` for process-level scraping)."""
-        out = {
-            "slots": len(self.slots),
-            "active": sum(1 for s in self.slots if s.req is not None),
-            "queued": len(self._queue),
-            "finished_unclaimed": len(self._done),
-            "admitted": self._admitted,
-            "completed": self._completed,
-            "ticks": self._ticks,
-            # Resident KV bytes across layouts (slot strips, int8 value+
-            # scale pairs, or page pools) — the capacity number benches
-            # and dashboards report.
-            "cache_bytes": sum(
-                x.nbytes for x in jax.tree.leaves(self._caches)
-            ),
-        }
-        if self._paged:
-            ps = self._pager.stats()
-            out["pool_pages"] = ps.num_pages
-            out["pages_in_use"] = ps.in_use
-            out["pages_free"] = ps.free
-            out["pages_cached"] = ps.cached
-            out["prefix_hits"] = ps.prefix_hits
-            out["prefix_misses"] = ps.prefix_misses
+        # Snapshot under _cv so the counts are mutually consistent even
+        # when the server thread is mid-tick (ADVICE r4 — unlocked reads
+        # were benign under the GIL but could tear across fields).
+        with self._cv:
+            out = {
+                "slots": len(self.slots),
+                "active": sum(1 for s in self.slots if s.req is not None),
+                "queued": len(self._queue),
+                "finished_unclaimed": len(self._done),
+                "admitted": self._admitted,
+                "completed": self._completed,
+                "ticks": self._ticks,
+                # Resident KV bytes across layouts (slot strips, int8
+                # value+scale pairs, or page pools) — the capacity number
+                # benches and dashboards report.
+                "cache_bytes": sum(
+                    x.nbytes for x in jax.tree.leaves(self._caches)
+                ),
+            }
+            if self._paged:
+                ps = self._pager.stats()
+                out["pool_pages"] = ps.num_pages
+                out["pages_in_use"] = ps.in_use
+                out["pages_free"] = ps.free
+                out["pages_cached"] = ps.cached
+                out["prefix_hits"] = ps.prefix_hits
+                out["prefix_misses"] = ps.prefix_misses
+                out["prefix_capacity_skips"] = ps.prefix_capacity_skips
         return out
 
     def logprobs(self, req_id: int) -> np.ndarray:

@@ -47,6 +47,7 @@ class PagerStats:
     cached: int  # rc == 0 but resident for prefix reuse
     prefix_hits: int
     prefix_misses: int
+    prefix_capacity_skips: int  # resident page, but the table row was full
 
 
 class Pager:
@@ -82,6 +83,7 @@ class Pager:
         )
         self.prefix_hits = 0
         self.prefix_misses = 0
+        self.prefix_capacity_skips = 0
 
     # -- raw pages ---------------------------------------------------------
 
@@ -185,8 +187,16 @@ class Pager:
         if page is None:
             self.prefix_misses += 1
             return None
-        if len(self._owned[slot]) + 1 > self.pages_per_slot:
-            return None  # table row full — cannot take the share
+        # Row-capacity check mirrors alloc()'s accounting: the recycled
+        # window base occupies leading ordinals even though the pages are
+        # gone (ADVICE r4 — len(owned) alone silently overflowed the row
+        # for any future caller sharing into a partially-recycled slot).
+        if self._base[slot] + len(self._owned[slot]) + 1 > self.pages_per_slot:
+            # A miss for accounting (hits+misses == probes) with its own
+            # counter: the page WAS resident, the row was just full.
+            self.prefix_misses += 1
+            self.prefix_capacity_skips += 1
+            return None
         self._lru.pop(page, None)
         self._rc[page] = self._rc.get(page, 0) + 1
         self._owned[slot].append(page)
@@ -209,6 +219,7 @@ class Pager:
             cached=len(self._lru),
             prefix_hits=self.prefix_hits,
             prefix_misses=self.prefix_misses,
+            prefix_capacity_skips=self.prefix_capacity_skips,
         )
 
 

@@ -54,12 +54,29 @@ RESNET50_FLOPS_PER_IMAGE = 8.2e9  # fwd pass @224x224, mul+add as 2
 TPU_V5E_PEAK_FLOPS = 197e12  # bf16
 BATCH = 32
 
-#: (platform, iters, trials, timeout_s, backoff_before_s). TPU gets three
-#: shots (first compile through the tunnel is slow; a flaky relay often
-#: recovers within a minute — and this round saw multi-hour outages, so a
-#: final attempt after a 5-minute backoff buys one more recovery window);
-#: CPU is the evidence-of-life fallback with a small iteration count —
-#: ResNet-50 bs=32 on CPU is ~seconds per batch.
+#: Persistent XLA compilation cache, shared between the in-round benchmark
+#: queues and this driver-run script. The r03/r04 postmortem: the driver's
+#: TPU shots spent their whole window on FIRST COMPILE through a degraded
+#: relay and timed out, so two rounds of real TPU perf never reached the
+#: official artifact. The queue seeds this cache with the exact child
+#: programs below (both scan lengths); the driver's shots then pay
+#: execution, not compile. Best-effort: if the tunnel's PJRT plugin cannot
+#: serialize executables, JAX warns and runs uncached — never fails.
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+CACHE_ENV = {
+    "JAX_COMPILATION_CACHE_DIR": CACHE_DIR,
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+    "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "-1",
+}
+
+#: Healthy-relay schedule: (platform, iters, trials, timeout_s,
+#: backoff_before_s). TPU gets three shots (first compile through the
+#: tunnel is slow; a flaky relay often recovers within a minute — and
+#: r04 saw multi-hour outages, so a final attempt after a 5-minute
+#: backoff buys one more recovery window); CPU is the evidence-of-life
+#: fallback with a small iteration count — ResNet-50 bs=32 on CPU is
+#: ~seconds per batch. A HUNG probe switches to the tiny-first
+#: escalating schedule in main() instead.
 ATTEMPTS = [
     ("tpu", 100, 5, 600, 0),
     ("tpu", 100, 3, 420, 30),
@@ -76,6 +93,18 @@ def _child(
     stem: str = "conv7",
 ) -> None:
     import jax
+
+    # Belt-and-braces with CACHE_ENV (parent may be bypassed: queue scripts
+    # invoke --child directly): enable the persistent compilation cache
+    # before the first compile. Guarded — cache config must never break a
+    # measurement.
+    try:
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+
     import jax.numpy as jnp
 
     from adapt_tpu.models.resnet import resnet50
@@ -144,6 +173,7 @@ def main() -> int:
     # (the documented gotcha), turning the guard itself into a hang.
     import tempfile
 
+    probe_hung = False  # any non-TimeoutExpired failure = not hung (ADVICE r4)
     with tempfile.TemporaryFile() as probe_err:
         try:
             probe = subprocess.run(
@@ -153,7 +183,6 @@ def main() -> int:
                 timeout=120,
                 start_new_session=True,
             )
-            probe_hung = False
             if probe.returncode != 0:
                 probe_err.seek(0)
                 tail = probe_err.read()[-200:].decode(errors="replace")
@@ -162,13 +191,16 @@ def main() -> int:
                 )
         except subprocess.TimeoutExpired:
             probe_hung = True
-    if probe_hung:
-        notes.append("relay probe HUNG (120s); shortened TPU schedule")
-        attempts = [("tpu", 100, 3, 300, 0), ("cpu", 3, 2, 600, 0)]
-    for platform, iters, trials, timeout_s, backoff_s in attempts:
-        if backoff_s:
-            time.sleep(backoff_s)
+        except Exception as exc:  # OSError etc: record, keep full schedule
+            notes.append(f"relay probe error: {exc!r}")
+
+    cache_warm = os.path.isdir(CACHE_DIR) and bool(os.listdir(CACHE_DIR))
+
+    def _attempt(platform: str, iters: int, trials: int, timeout_s: int):
+        """One child measurement; returns the parsed record or None,
+        appending the failure reason to ``notes``."""
         env = dict(os.environ)
+        env.update(CACHE_ENV)
         if platform == "cpu":
             # Drop the axon relay hook: with the TPU tunnel down, imports
             # through it hang; the CPU run must be hermetic.
@@ -200,35 +232,13 @@ def main() -> int:
                 timeout=timeout_s,
             )
         except subprocess.TimeoutExpired:
-            notes.append(f"{platform}: timeout after {timeout_s}s")
+            notes.append(f"{platform} iters={iters}: timeout after {timeout_s}s")
             print(
                 f"bench attempt on {platform} timed out ({timeout_s}s)",
                 file=sys.stderr,
             )
-            continue
-        if proc.returncode == 0:
-            record = None
-            for line in proc.stdout.splitlines():
-                line = line.strip()
-                if line.startswith("{"):
-                    try:
-                        record = json.loads(line)
-                        break
-                    except json.JSONDecodeError:
-                        continue
-            if record is None:
-                notes.append(f"{platform}: exited 0 but printed no JSON")
-            elif platform == "tpu" and record.get("platform") == "cpu":
-                # JAX silently fell back to CPU inside a TPU attempt —
-                # reject it; a real (flagged) CPU fallback is the last
-                # attempt's job.
-                notes.append("tpu attempt silently ran on cpu")
-            else:
-                if notes:
-                    record["note"] = "; ".join(notes)
-                print(json.dumps(record), flush=True)
-                return 0
-        else:
+            return None
+        if proc.returncode != 0:
             tail = (proc.stderr or proc.stdout or "").strip().splitlines()
             tail = " | ".join(tail[-3:])[-500:]
             notes.append(
@@ -236,6 +246,55 @@ def main() -> int:
                 f"{time.time() - t0:.0f}s: {tail}"
             )
             print(f"bench attempt on {platform} failed: {tail}", file=sys.stderr)
+            return None
+        record = None
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    record = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+        if record is None:
+            notes.append(f"{platform}: exited 0 but printed no JSON")
+        elif platform == "tpu" and record.get("platform") == "cpu":
+            # JAX silently fell back to CPU inside a TPU attempt — reject
+            # it; a real (flagged) CPU fallback is the last attempt's job.
+            notes.append("tpu attempt silently ran on cpu")
+            record = None
+        return record
+
+    def _emit(record) -> int:
+        record["compile_cache"] = "warm" if cache_warm else "cold"
+        if notes:
+            record["note"] = "; ".join(notes)
+        print(json.dumps(record), flush=True)
+        return 0
+
+    if probe_hung:
+        # Degraded relay (r04 postmortem: even the degraded shot burned its
+        # 300 s on first compile). Tiny first — 10 scan iters, 2 trials,
+        # compile cached from the queue seed — then ESCALATE to the full
+        # config only once the relay has proven it can execute at all. A
+        # successful tiny shot is kept as the floor if escalation dies.
+        notes.append("relay probe HUNG (120s); tiny-first TPU schedule")
+        tiny = _attempt("tpu", 10, 2, 300)
+        if tiny is not None:
+            full = _attempt("tpu", 100, 5, 420)
+            return _emit(full if full is not None else tiny)
+    else:
+        for platform, iters, trials, timeout_s, backoff_s in attempts:
+            if backoff_s:
+                time.sleep(backoff_s)
+            record = _attempt(platform, iters, trials, timeout_s)
+            if record is not None:
+                return _emit(record)
+    # Degraded path fallthrough: evidence-of-life CPU row.
+    if probe_hung:
+        record = _attempt("cpu", 3, 2, 600)
+        if record is not None:
+            return _emit(record)
 
     # Every attempt failed: still honor the one-JSON-line, rc=0 contract so
     # the driver records a diagnostic instead of a crash.

@@ -19,6 +19,20 @@ import json
 import os
 
 
+#: Current round's artifact directory (drivers append JSONL rows here).
+#: Env-overridable so old rows can be regenerated in place if needed.
+ROUND = os.environ.get("BENCH_ROUND", "r05")
+
+
+def out_path(name: str) -> str:
+    """``benchmarks/results/<round>/<name>`` for the append-only JSONL
+    artifact convention (error rows land BESIDE good rows, never over
+    them)."""
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results", ROUND, name
+    )
+
+
 def force_cpu_mesh(n_devices: int) -> None:
     """Force an ``n_devices`` virtual CPU mesh (post-import safe). Thin
     wrapper over ``__graft_entry__._force_virtual_cpu`` — the drivers put

@@ -14,7 +14,7 @@ counted = requested tokens only, both sides). ``vs_baseline`` =
 continuous/batch-synchronous tokens-per-sec (>1 means the slot recycling
 beats the convoy).
 
-Artifact: results/r04/continuous_serve.json. Runs on the real chip by
+Artifact: results/<round>/continuous_serve.json. Runs on the real chip by
 default; ``--cpu`` validates the schedule on the host backend (and is
 what CI-grade environments can run). Honest caveat on the CPU number:
 with the tiny validation model a decode step is microseconds of real
@@ -37,7 +37,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks.common import int_flag, str_flag  # noqa: E402  (no JAX)
+from benchmarks.common import int_flag, out_path, str_flag  # noqa: E402  (no JAX)
 
 VOCAB, DIM, DEPTH, HEADS, MLP = 50257, 768, 12, 12, 3072
 PROMPT_LEN, MAX_LEN = 32, 256
@@ -50,10 +50,7 @@ def metric_name(slots: int, layout: str) -> str:
     suffix = "_paged" if layout == "paged" else ""
     return f"continuous_serve_slots{slots}{suffix}_tokens_per_sec"
 STEP_MIX = (16, 96, 32, 128)  # short/long interleave — the convoy case
-OUT = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), "results", "r04",
-    "continuous_serve.json",
-)
+OUT = out_path("continuous_serve.json")
 
 
 def _child(slots: int, n_requests: int, small: bool, chunk: int,

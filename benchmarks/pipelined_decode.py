@@ -34,7 +34,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks.common import int_flag  # noqa: E402  (imports no JAX)
+from benchmarks.common import int_flag, out_path  # noqa: E402  (imports no JAX)
 
 VOCAB, DIM, DEPTH, HEADS, MLP = 1024, 256, 8, 8, 1024
 PROMPT_LEN, MAX_LEN = 16, 128
@@ -54,9 +54,7 @@ def _out_path(tag: str) -> str:
         if tag == _tag(DEFAULT_PP, DEFAULT_DP)
         else f"pipelined_decode_{tag}.json"
     )
-    return os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "results", "r04", name
-    )
+    return out_path(name)
 
 
 def _child(pp: int, batch: int, steps: int, trials: int, dp: int) -> None:

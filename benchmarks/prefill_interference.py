@@ -36,14 +36,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from benchmarks.common import (  # noqa: E402  (imports no JAX)
     int_flag,
+    out_path,
     run_child_json,
 )
 
 VOCAB, DIM, DEPTH, HEADS, MLP = 50257, 768, 12, 12, 3072
-OUT = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), "results", "r04",
-    "prefill_interference.json",
-)
+OUT = out_path("prefill_interference.json")
 
 
 def _run_mode(ContinuousBatcher, np, lm, variables, long_len, n_long,

@@ -1,0 +1,92 @@
+#!/bin/bash
+# Round-5 TPU queue, run 1 — scoreboard-critical rows first (VERDICT r4
+# #1/#2/#3/#8/#9). Serial by design: NEVER two JAX processes through the
+# relay at once. Every child under its own timeout; artifacts append
+# (JSONL) beside older rows, never over them.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/results/r05
+mkdir -p "$OUT"
+log() { echo "=== $(date +%H:%M:%S) $*"; }
+
+# Persistent XLA compilation cache, shared with the driver-run bench.py:
+# every compile this queue pays is one the driver's degraded-relay shot
+# won't (VERDICT r4 #1).
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0
+export JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES=-1
+export BENCH_ROUND=r05
+
+log "0. bench.py headline: seed the cache with BOTH child programs the"
+log "   driver can run (tiny iters=10 first, then the full iters=100)"
+timeout 600 python bench.py --child --platform tpu --iters 10 --trials 2 \
+  | tail -1 | tee -a "$OUT/bench_preview.json"
+timeout 900 python bench.py --child --platform tpu --iters 100 --trials 5 \
+  | tail -1 | tee -a "$OUT/bench_preview.json"
+# Gate the rest of the queue on the headline actually executing: if even
+# bench.py can't run, every later row would burn its timeout too.
+tail -1 "$OUT/bench_preview.json" | grep -q '"platform": "tpu"' || {
+  echo "headline preview did not run on tpu; aborting queue"; exit 1; }
+
+log "1. decode-attention kernel compiled smoke (gate for the A/B rows)"
+timeout 900 python benchmarks/decode_attn_smoke.py \
+  | tail -1 | tee -a "$OUT/decode_attn_smoke.json"
+tail -1 "$OUT/decode_attn_smoke.json" | grep -q '"vs_baseline": 1.0' || {
+  echo "decode kernel smoke FAILED on-chip; skipping kernel rows"; SKIP_PALLAS=1; }
+
+log "2. decode A/B at 2k context: fresh XLA control + kernel rows"
+timeout 1800 python benchmarks/lm_decode.py --prompt 1024 --maxlen 2048 \
+  --steps 128 | tail -1 | tee -a "$OUT/lm_decode_long_native.json"
+timeout 1800 python benchmarks/lm_decode.py --prompt 1024 --maxlen 2048 \
+  --steps 128 --kv int8 | tail -1 | tee -a "$OUT/lm_decode_long_int8.json"
+if [ -z "${SKIP_PALLAS:-}" ]; then
+  timeout 1800 python benchmarks/lm_decode.py --prompt 1024 --maxlen 2048 \
+    --steps 128 --decode-attn pallas | tail -1 \
+    | tee -a "$OUT/lm_decode_long_native_pallas.json"
+  timeout 1800 python benchmarks/lm_decode.py --prompt 1024 --maxlen 2048 \
+    --steps 128 --kv int8 --decode-attn pallas | tail -1 \
+    | tee -a "$OUT/lm_decode_long_int8_pallas.json"
+fi
+
+log "3. continuous batching at serving scale (GPT-2-small width, mixed mix)"
+timeout 2700 python benchmarks/continuous_serve.py --slots 8 \
+  --requests 32 --chunk 16 | tail -1
+timeout 2700 python benchmarks/continuous_serve.py --slots 8 \
+  --requests 32 --chunk 16 --layout paged | tail -1
+
+log "4. short-context decode row (MBU baseline for this round's roofline work)"
+timeout 1800 python benchmarks/lm_decode.py | tail -1 \
+  | tee -a "$OUT/lm_decode.json"
+
+log "5. ViT rows with the fixed mul+add-as-2 MFU accounting"
+timeout 1200 python benchmarks/tpu_models.py --model vit_b16 --batch 32 \
+  | tail -1 | tee -a "$OUT/vit_b16_bs32.json"
+timeout 1200 python benchmarks/tpu_models.py --model vit_b16 --batch 64 \
+  --resident bf16 | tail -1 | tee -a "$OUT/vit_b16_bs64_res_bf16.json"
+timeout 1800 python benchmarks/tpu_models.py --model vit_b16 --batch 128 \
+  | tail -1 | tee -a "$OUT/vit_b16_bs128.json"
+
+log "6. MoE decode: 8 experts top-2 at GPT-2 width (single-chip dense-EP)"
+timeout 1800 python benchmarks/lm_decode.py --moe 8 | tail -1 \
+  | tee -a "$OUT/lm_decode_moe8.json"
+
+log "7. sliding-window decode at 4k context"
+timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
+  --steps 128 --window 1024 | tail -1 \
+  | tee -a "$OUT/lm_decode_4k_win1024.json"
+timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
+  --steps 128 | tail -1 | tee -a "$OUT/lm_decode_4k_native.json"
+if [ -z "${SKIP_PALLAS:-}" ]; then
+  timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
+    --steps 128 --decode-attn pallas | tail -1 \
+    | tee -a "$OUT/lm_decode_4k_native_pallas.json"
+  timeout 1800 python benchmarks/lm_decode.py --prompt 3072 --maxlen 4096 \
+    --steps 128 --kv int8 --decode-attn pallas | tail -1 \
+    | tee -a "$OUT/lm_decode_4k_int8_pallas.json"
+fi
+
+log "8. prefill interference: chunked-prefill p99 shield at serving scale"
+timeout 2700 python benchmarks/prefill_interference.py --long 1536 \
+  --chunk 256 | tail -1
+
+log "queue1 done"

@@ -37,14 +37,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks.common import int_flag, str_flag  # noqa: E402  (no JAX)
+from benchmarks.common import int_flag, out_path, str_flag  # noqa: E402  (no JAX)
 
 VOCAB, DIM, DEPTH, HEADS, MLP = 50257, 768, 12, 12, 3072
 PROMPT_LEN, MAX_LEN = 32, 256
-OUT = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), "results", "r04",
-    "speculative_decode.json",
-)
+OUT = out_path("speculative_decode.json")
 
 
 def _child(draft_kind: str, k: int, steps: int, small: bool) -> None:
