@@ -85,6 +85,26 @@ MSG_HELLO_ACK = 14
 #: handshake the dispatcher has already declared dead. ``request_id`` is
 #: the generation to revoke, or 0 to drop whatever is installed.
 MSG_UNCONFIGURE = 15
+#: Install (or clear) a direct next-hop for a stage's outputs: Gen-1 chain
+#: topology (the reference worker forwards activations straight to the
+#: next worker's data port, ``/root/reference/src/node.py:163-179``),
+#: rebuilt as an OPT-IN fast path for static healthy pools. Payload JSON:
+#: ``{"next": [host, port], "next_stage": j}`` = forward my stage's
+#: output as MSG_DATA for stage ``j`` directly to that worker;
+#: ``{"next": null}`` = I am the chain tail — send MSG_RESULT on the
+#: dispatcher link; ``{"clear": true}`` = revert to hub routing. The
+#: worker ACKs with the frame's ``request_id`` (a proxy generation), so
+#: route installs are reliable, not fire-and-forget. Errors (exec OR
+#: forward failures) always go hub-ward on the dispatcher link — the
+#: chain carries the data plane only, the hub keeps the control plane
+#: (probes, deadlines, exactly-once, re-dispatch).
+MSG_SET_ROUTE = 16
+#: Chain-routed data. Routes apply ONLY to this type: after a chain
+#: failure the hub falls back to per-stage dispatch with plain MSG_DATA,
+#: which must return results hub-ward even if a stale route is still
+#: installed on the worker (clears are best-effort on a possibly-dead
+#: link). The frame type, not worker state, decides the topology.
+MSG_DATA_CHAINED = 17
 
 
 # --------------------------------------------------------------------------
@@ -112,6 +132,17 @@ class RemoteStageServer:
         self._codec: codec_lib.Codec = codec_lib.get_codec("none")
         self._hung = False
         self._crashed = False
+        #: stage -> {"next": (host, port) | None, "next_stage": int}.
+        #: Present = chain mode for that stage; "next" None = chain tail.
+        self._routes: dict[int, dict] = {}
+        #: (host, port) -> (socket, send lock) persistent forward links.
+        self._fwd: dict[tuple, tuple[socket.socket, threading.Lock]] = {}
+        self._fwd_lock = threading.Lock()
+        #: reply() of the dispatcher connection (the one control frames
+        #: arrive on). Chain-tail results and chain errors go here — the
+        #: data may have arrived on a peer worker's connection, but the
+        #: hub owns completion and recovery.
+        self._primary_reply = None
 
     def _build_stage(self, cfg: dict, leaves: list):
         """Rebuild the named model, slice it, and load the stage weights
@@ -168,6 +199,65 @@ class RemoteStageServer:
         self._stages[idx] = (fn, variables)
         self._codec = codec_lib.get_codec(cfg.get("codec", "none"))
 
+    #: Bound on forward-link sends: a wedged next hop must error this
+    #: request hub-ward (where the replay machinery lives), not freeze the
+    #: serving thread forever while pings keep the lease alive.
+    FWD_SEND_TIMEOUT_S = 15.0
+
+    def _fwd_connect(
+        self, addr: tuple
+    ) -> tuple[socket.socket, threading.Lock]:
+        """Persistent forward link to the next chain worker. The peer's
+        serve loop answers pings (and nothing we care about) on it, so a
+        drain thread discards inbound frames — without it the peer's ping
+        writes would slowly fill the TCP buffer of a socket nobody reads."""
+        with self._fwd_lock:
+            entry = self._fwd.get(addr)
+            if entry is not None:
+                return entry
+            sock = socket.create_connection(addr, timeout=5.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # Timeout bounds SENDS; the drain thread's reads retry through
+            # it (framing's retry_on_timeout default).
+            sock.settimeout(self.FWD_SEND_TIMEOUT_S)
+            entry = (sock, threading.Lock())
+            self._fwd[addr] = entry
+
+        def drain():
+            try:
+                while True:
+                    recv_msg(sock)
+            except (ConnectionError, OSError):
+                self._fwd_drop(addr, sock)
+
+        threading.Thread(target=drain, daemon=True).start()
+        return entry
+
+    def _fwd_drop(self, addr: tuple, sock: socket.socket) -> None:
+        """Evict (and close) a forward link. A send failure MUST come
+        through here: bytes may be half-written, so the stream is
+        unusable — a later ``setup_chain`` over the same topology has to
+        re-dial, not cache-hit a desynced socket."""
+        with self._fwd_lock:
+            if self._fwd.get(addr) is not None and self._fwd[addr][0] is sock:
+                del self._fwd[addr]
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _fwd_gc(self) -> None:
+        """Close forward links no live route references (route cleared or
+        re-pointed): without this, every chain reconfiguration would leak
+        a socket here plus a handler+ping thread pair on the peer."""
+        live = {r["next"] for r in self._routes.values() if r["next"]}
+        with self._fwd_lock:
+            dead = [
+                (a, s) for a, (s, _) in self._fwd.items() if a not in live
+            ]
+        for addr, sock in dead:
+            self._fwd_drop(addr, sock)
+
     def _handle(self, conn: socket.socket) -> int:
         """Serve one connection until it closes; returns the number of
         messages processed (0 = the peer closed before saying anything —
@@ -218,12 +308,51 @@ class RemoteStageServer:
                     ]:
                         del pending[key]
                 if msg.msg_type == MSG_CONFIG:
+                    # Only the dispatcher configures; remember its link so
+                    # chained results/errors route hub-ward even when the
+                    # triggering data frame came from a peer worker.
+                    self._primary_reply = reply
                     cfg = json.loads(msg.payload.decode())
                     pending[(msg.stage_index, msg.request_id)] = {
                         "cfg": cfg,
                         "arrays": {},
                         "ts": time.monotonic(),
                     }
+                elif msg.msg_type == MSG_SET_ROUTE:
+                    self._primary_reply = reply
+                    try:
+                        info = json.loads(msg.payload.decode())
+                        if info.get("clear"):
+                            self._routes.pop(msg.stage_index, None)
+                            self._fwd_gc()
+                        else:
+                            nxt = info.get("next")
+                            route = {
+                                "next": tuple(nxt) if nxt else None,
+                                "next_stage": info.get("next_stage", -1),
+                            }
+                            if route["next"] is not None:
+                                # Pre-dial so an unreachable next hop fails
+                                # the install, not the first request.
+                                self._fwd_connect(route["next"])
+                            self._routes[msg.stage_index] = route
+                            self._fwd_gc()
+                        reply(
+                            Message(
+                                MSG_ACK, msg.stage_index, msg.request_id, 0, b""
+                            )
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        log.error("route install failed: %s", e)
+                        reply(
+                            Message(
+                                MSG_CONFIG_ERR,
+                                msg.stage_index,
+                                msg.request_id,
+                                0,
+                                str(e).encode(),
+                            )
+                        )
                 elif msg.msg_type == MSG_CONFIG_ARRAY:
                     entry = pending.get((msg.stage_index, msg.request_id))
                     if entry is not None:
@@ -288,7 +417,7 @@ class RemoteStageServer:
                         )
                 elif msg.msg_type == MSG_HELLO_ACK:
                     continue  # join handshake answer; nothing to do
-                elif msg.msg_type == MSG_DATA:
+                elif msg.msg_type in (MSG_DATA, MSG_DATA_CHAINED):
                     if self._hung:
                         continue  # swallow; watchdog must recover
                     self._execute(reply, msg)
@@ -320,7 +449,23 @@ class RemoteStageServer:
         return n_msgs
 
     def _execute(self, reply, msg: Message) -> None:
+        # Chain errors must reach the HUB (which owns re-dispatch), not the
+        # upstream peer whose forward socket nobody answers on (its drain
+        # thread discards frames). Routes bind to the frame type: hub-path
+        # MSG_DATA ignores them.
+        chained = msg.msg_type == MSG_DATA_CHAINED
+        route = self._routes.get(msg.stage_index) if chained else None
+        err_reply = (self._primary_reply or reply) if chained else reply
         try:
+            if chained and route is None:
+                # The route was cleared while this frame was in flight:
+                # there is no legitimate routeless chained frame. Error
+                # hub-ward NOW so the dispatcher replays immediately
+                # instead of waiting out a full chain deadline.
+                raise RuntimeError(
+                    f"chained frame for stage {msg.stage_index} arrived "
+                    "after its route was cleared"
+                )
             entry = self._stages.get(msg.stage_index)
             if entry is None:
                 raise RuntimeError(f"stage {msg.stage_index} not configured")
@@ -331,21 +476,64 @@ class RemoteStageServer:
             # Device array handed to the codec directly: int8dev quantizes
             # on-chip before the host fetch; host codecs coerce themselves.
             out = codec_lib.pack(self._codec, y)
-            reply(
-                Message(
-                    MSG_RESULT, msg.stage_index, msg.request_id, msg.attempt, out
+            if route is None:
+                # Hub routing: the stage output returns whence it came.
+                reply(
+                    Message(
+                        MSG_RESULT,
+                        msg.stage_index,
+                        msg.request_id,
+                        msg.attempt,
+                        out,
+                    )
                 )
-            )
+            elif route["next"] is None:
+                # Chain tail: the FINAL result goes to the dispatcher link
+                # (the request's data may have hopped in from a peer).
+                (self._primary_reply or reply)(
+                    Message(
+                        MSG_RESULT,
+                        msg.stage_index,
+                        msg.request_id,
+                        msg.attempt,
+                        out,
+                    )
+                )
+            else:
+                # Mid-chain: the activation goes straight to the next
+                # worker — the hub never touches it (SURVEY §3.2's 2·S-hop
+                # critique; reference Gen-1 ``src/node.py:163-179``).
+                sock, lock = self._fwd_connect(route["next"])
+                try:
+                    with lock:
+                        send_msg(
+                            sock,
+                            Message(
+                                MSG_DATA_CHAINED,
+                                route["next_stage"],
+                                msg.request_id,
+                                msg.attempt,
+                                out,
+                            ),
+                        )
+                except (TimeoutError, OSError):
+                    # Half-written frame: the stream is dead. Evict it so
+                    # a chain re-enable re-dials, then report hub-ward.
+                    self._fwd_drop(route["next"], sock)
+                    raise
         except Exception as e:  # noqa: BLE001
-            reply(
-                Message(
-                    MSG_ERROR,
-                    msg.stage_index,
-                    msg.request_id,
-                    msg.attempt,
-                    str(e).encode(),
+            try:
+                err_reply(
+                    Message(
+                        MSG_ERROR,
+                        msg.stage_index,
+                        msg.request_id,
+                        msg.attempt,
+                        str(e).encode(),
+                    )
                 )
-            )
+            except Exception:  # noqa: BLE001 — error path must not recurse
+                log.warning("could not report execute error hub-ward")
 
     def serve_forever(self) -> None:
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -360,7 +548,12 @@ class RemoteStageServer:
             except socket.timeout:
                 continue
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._handle(conn)
+            # Thread per connection: chain mode means a PEER worker dials
+            # in with data while the dispatcher link is mid-service — a
+            # serial accept loop would never serve the second link.
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
         srv.close()
 
     def connect_and_serve(
@@ -462,6 +655,14 @@ class RemoteWorkerProxy:
         re-configuring the same stage — pay the compression pass once."""
         self.worker_id = worker_id
         self.address = address
+        #: Dial-out proxies know the worker's LISTENING address — the one
+        #: a chain peer can reach it at. Gateway joiners' ``address`` is
+        #: an ephemeral client port, useless as a next hop.
+        self._dialed_out = sock is None
+        #: MSG_RESULT/MSG_ERROR frames this link delivered — lets tests
+        #: (and the chain A/B) prove the hub never saw mid-chain traffic.
+        self.results_received = 0
+        self.result_bytes_received = 0
         self._registry = registry
         self._results = result_queue
         self._fault = fault or FaultConfig()
@@ -697,6 +898,62 @@ class RemoteWorkerProxy:
                 self._config_acks.pop(key, None)
                 self._config_errors.pop(key, None)
 
+    @property
+    def chain_address(self) -> tuple[str, int] | None:
+        """Where a chain peer can dial this worker, or None when unknown
+        (gateway joiners don't announce a listen port)."""
+        return self.address if self._dialed_out else None
+
+    def send_route(
+        self,
+        stage_index: int,
+        next_addr: tuple[str, int] | None,
+        next_stage: int = -1,
+        clear: bool = False,
+    ) -> None:
+        """Install (or clear) the worker's direct next-hop for
+        ``stage_index``. Installs wait for the ACK — reliable, like
+        configure. CLEARS are fire-and-forget with a short lock wait:
+        they run on the shared forward pool right when a chain just
+        failed, and correctness never depends on them (hub traffic uses
+        plain MSG_DATA, which ignores routes) — blocking recovery threads
+        for configure_timeout_s per clear would starve the replay path.
+        ``next_addr=None`` (without ``clear``) marks the chain tail."""
+        gen = next(self._config_gen)
+        key = (stage_index, gen)
+        payload = json.dumps(
+            {"clear": True}
+            if clear
+            else {
+                "next": list(next_addr) if next_addr else None,
+                "next_stage": next_stage,
+            }
+        ).encode()
+        if clear:
+            self._send(
+                Message(MSG_SET_ROUTE, stage_index, gen, 0, payload),
+                lock_timeout=1.0,
+            )
+            return
+        ack = threading.Event()
+        with self._ack_lock:
+            self._config_acks[key] = ack
+        try:
+            self._send(Message(MSG_SET_ROUTE, stage_index, gen, 0, payload))
+            if not ack.wait(self._fault.configure_timeout_s):
+                raise TimeoutError(
+                    f"no route ACK for stage {stage_index} from "
+                    f"{self.worker_id}"
+                )
+            with self._ack_lock:
+                err = self._config_errors.pop(key, None)
+            if err is not None:
+                raise RuntimeError(f"route install failed: {err}")
+        finally:
+            with self._ack_lock:
+                self._config_acks.pop(key, None)
+                self._config_errors.pop(key, None)
+
     def unconfigure(
         self, stage_index: int, generation: int | None = None
     ) -> None:
@@ -738,6 +995,21 @@ class RemoteWorkerProxy:
         # (int8dev) quantize on-chip BEFORE the host fetch; host codecs
         # call np.ascontiguousarray themselves.
         payload = codec_lib.pack(self._codec, task.payload)
+        if getattr(task, "chained", False):
+            # Chain-mode head submit: the RESULT arrives on the TAIL
+            # worker's link, so counting it here would leak this proxy's
+            # in-flight depth forever. The dispatcher tracks chain
+            # requests in its own in-flight registry.
+            self._send(
+                Message(
+                    MSG_DATA_CHAINED,
+                    task.stage_index,
+                    task.request_id,
+                    task.attempt,
+                    payload,
+                )
+            )
+            return
         with self._count_lock:
             self._inflight_count += 1
         try:
@@ -794,6 +1066,8 @@ class RemoteWorkerProxy:
                 if ev is not None:
                     ev.set()
             elif msg.msg_type in (MSG_RESULT, MSG_ERROR):
+                self.results_received += 1
+                self.result_bytes_received += len(msg.payload)
                 with self._count_lock:
                     self._inflight_count = max(0, self._inflight_count - 1)
                 if msg.msg_type == MSG_RESULT:

@@ -100,6 +100,11 @@ class _Inflight:
     # ALL of them, not just the latest (a pool with several hung workers
     # must not bounce one request among them until retries burn out).
     tried: set[str] = field(default_factory=set)
+    #: Chain mode (comm.remote direct forwarding): the stage index whose
+    #: result completes this request. None = hub routing (the entry's own
+    #: stage). A chain entry holds the ORIGINAL stage-0 payload, so any
+    #: chain failure re-dispatches end-to-end through the hub path.
+    final_stage: int | None = None
 
 
 class Dispatcher:
@@ -192,6 +197,11 @@ class Dispatcher:
         self._shutdown = threading.Event()
         self._threads: list[threading.Thread] = []
         self._started = False
+        #: Chain forwarding (opt-in, setup_chain): ordered worker ids, one
+        #: per stage; data hops worker→worker, only the tail's result (and
+        #: any error) returns to the hub. None = hub routing.
+        self._chain: list[str] | None = None
+        self._chain_lock = threading.Lock()
 
     # -- worker pool --------------------------------------------------------
 
@@ -397,6 +407,102 @@ class Dispatcher:
     def metrics_snapshot(self) -> dict:
         return global_metrics().snapshot()
 
+    # -- chain forwarding (opt-in data-plane topology) -----------------------
+
+    def setup_chain(self, worker_ids: list[str] | None = None) -> list[str]:
+        """Opt-in direct worker→worker forwarding for a static healthy
+        pool: stage ``i``'s output hops straight to stage ``i+1``'s worker
+        (reference Gen-1 topology, ``/root/reference/src/node.py:163-179``)
+        and only the tail's result returns to the hub — halving the DCN
+        hops of hub routing (SURVEY §3.2's 2·S critique). The hub keeps
+        the whole control plane: probes, deadlines, exactly-once and
+        re-dispatch are unchanged, and ANY chain failure (error frame,
+        deadline, member death) disables the chain and replays the
+        request end-to-end through the proven late-binding hub path —
+        the in-flight entry retains the original stage-0 payload.
+
+        ``worker_ids``: one per stage, in stage order. Default: the
+        dial-out remote proxies in attach order. Members must be
+        ``RemoteWorkerProxy``-shaped (send_route) and every non-head
+        member must be dialable by its predecessor (``chain_address``)."""
+        with self._workers_lock:
+            pool = {
+                wid: w
+                for wid, w in self._workers.items()
+                if w.state is not WorkerState.DEAD
+            }
+        if worker_ids is None:
+            worker_ids = [
+                wid
+                for wid, w in pool.items()
+                if getattr(w, "chain_address", None) is not None
+            ][: self.plan.num_stages]
+        if len(worker_ids) != self.plan.num_stages:
+            raise ValueError(
+                f"chain needs exactly {self.plan.num_stages} workers "
+                f"(one per stage), got {len(worker_ids)}"
+            )
+        workers = []
+        for i, wid in enumerate(worker_ids):
+            w = pool.get(wid)
+            if w is None:
+                raise ValueError(f"worker {wid!r} is not in the live pool")
+            if not hasattr(w, "send_route"):
+                raise TypeError(
+                    f"worker {wid!r} cannot chain (in-process workers "
+                    "share the hub's memory; chaining is a cross-host "
+                    "topology)"
+                )
+            if i > 0 and w.chain_address is None:
+                raise ValueError(
+                    f"worker {wid!r} has no dialable listen address "
+                    "(gateway joiners don't announce one)"
+                )
+            workers.append(w)
+        for i, w in enumerate(workers):
+            if not w.is_configured(i):
+                self._configure_with_timeout(w, i)
+        # Tail-first: no hop ever forwards into a worker missing its route.
+        for i in reversed(range(len(workers))):
+            if i + 1 < len(workers):
+                workers[i].send_route(i, workers[i + 1].chain_address, i + 1)
+            else:
+                workers[i].send_route(i, None)
+        with self._chain_lock:
+            self._chain = list(worker_ids)
+        log.info("chain forwarding enabled: %s", " -> ".join(worker_ids))
+        global_metrics().inc("dispatcher.chain_enabled")
+        return list(worker_ids)
+
+    def disable_chain(self, reason: str = "requested") -> None:
+        """Back to hub routing. Route clears are best-effort and async —
+        correctness doesn't need them: hub traffic uses plain MSG_DATA,
+        which ignores any stale route left on an unreachable worker."""
+        with self._chain_lock:
+            chain, self._chain = self._chain, None
+        if chain is None:
+            return
+        log.warning(
+            "chain forwarding disabled (%s); hub routing resumes", reason
+        )
+        global_metrics().inc("dispatcher.chain_disabled")
+        with self._workers_lock:
+            pool = dict(self._workers)
+
+        def _clear(stage: int, worker) -> None:
+            try:
+                worker.send_route(stage, None, clear=True)
+            except Exception:  # noqa: BLE001 — link may be down/dead
+                pass
+
+        for i, wid in enumerate(chain):
+            w = pool.get(wid)
+            if w is not None and hasattr(w, "send_route"):
+                try:
+                    self._forward_pool.submit(_clear, i, w)
+                except RuntimeError:  # pool shut down
+                    break
+
     # -- scheduling ---------------------------------------------------------
 
     def _acquire(self, stage_index: int, exclude: set[str]) -> StageWorker:
@@ -537,6 +643,54 @@ class Dispatcher:
             except Exception:  # noqa: BLE001 — non-array payloads: skip
                 pass
         exclude = exclude or set()
+        with self._chain_lock:
+            chain = self._chain
+        if (
+            chain is not None
+            and stage_index == 0
+            and retries == 0
+            and not exclude
+        ):
+            # Chain fast path: one submit to the head; the final result
+            # arrives from the tail worker's link. Retries/excludes never
+            # take it — a failed chain attempt replays through the hub.
+            with self._workers_lock:
+                head = self._workers.get(chain[0])
+            if head is not None and head.state is not WorkerState.DEAD:
+                entry = _Inflight(
+                    request_id=request_id,
+                    stage_index=0,
+                    attempt=attempt,
+                    payload=payload,
+                    worker_id=head.worker_id,
+                    start_time=time.monotonic(),
+                    retries=retries,
+                    future=future,
+                    tried={head.worker_id},
+                    final_stage=self.plan.num_stages - 1,
+                )
+                with self._inflight_lock:
+                    self._inflight[request_id] = entry
+                try:
+                    head.submit(
+                        Task(
+                            request_id=request_id,
+                            stage_index=0,
+                            attempt=attempt,
+                            payload=payload,
+                            chained=True,
+                        )
+                    )
+                except Exception as e:  # noqa: BLE001 — link just died
+                    with self._inflight_lock:
+                        self._inflight.pop(request_id, None)
+                    self.disable_chain(f"chain head submit failed: {e}")
+                else:
+                    global_metrics().inc("dispatcher.tasks_sent")
+                    global_metrics().inc("dispatcher.chain_dispatched")
+                    return
+            else:
+                self.disable_chain("chain head worker gone")
         worker = self._acquire(stage_index, exclude)
         entry = _Inflight(
             request_id=request_id,
@@ -579,7 +733,12 @@ class Dispatcher:
     def _redispatch(self, entry: _Inflight, reason: str) -> None:
         """Watchdog / failure path: re-send the retained payload to a
         different worker (reference watchdog intent, ``src/dispatcher.py:
-        302-304`` + §2.7 'late binding')."""
+        302-304`` + §2.7 'late binding'). A chain entry replays from its
+        original stage-0 payload through the hub path — the chain (if
+        still up) is disabled first, so the retry cannot re-enter the
+        topology that just failed it."""
+        if entry.final_stage is not None:
+            self.disable_chain(f"chain request replay: {reason}")
         if entry.retries + 1 > self.config.fault.max_retries:
             with self._inflight_lock:
                 self._inflight.pop(entry.request_id, None)
@@ -685,17 +844,37 @@ class Dispatcher:
                 continue
             with self._inflight_lock:
                 entry = self._inflight.get(result.request_id)
-                if (
-                    entry is None
-                    or entry.stage_index != result.stage_index
-                    or entry.attempt != result.attempt
-                ):
+                if entry is not None and entry.final_stage is not None:
+                    # Chain entry: SUCCESS must come from the tail stage;
+                    # an ERROR matches from ANY hop (a mid-chain worker
+                    # reports its failures hub-ward with its own stage
+                    # index).
+                    matches = entry.attempt == result.attempt and (
+                        result.error is not None
+                        or result.stage_index == entry.final_stage
+                    )
+                else:
+                    matches = (
+                        entry is not None
+                        and entry.stage_index == result.stage_index
+                        and entry.attempt == result.attempt
+                    )
+                if not matches:
                     # Stale duplicate (late completion after re-dispatch) —
                     # the duplication bug the reference had (SURVEY §7.4).
                     global_metrics().inc("dispatcher.stale_results")
                     continue
                 del self._inflight[result.request_id]
             if result.error is not None:
+                if entry.final_stage is not None:
+                    # A broken chain never self-heals into the same break:
+                    # fall back to hub routing for everything, then replay
+                    # this request end-to-end from its retained original
+                    # payload.
+                    self.disable_chain(
+                        f"chain error at stage {result.stage_index}: "
+                        f"{result.error}"
+                    )
                 self._forward_pool.submit(
                     self._redispatch, entry, f"error: {result.error}"
                 )
@@ -851,11 +1030,27 @@ class Dispatcher:
                 overdue: list[_Inflight] = []
                 with self._inflight_lock:
                     for rid, entry in list(self._inflight.items()):
-                        if now - entry.start_time > deadline:
+                        # A chain entry spans the WHOLE pipeline between
+                        # hub touches; its deadline scales with the
+                        # stage count.
+                        limit = deadline * (
+                            self.plan.num_stages
+                            if entry.final_stage is not None
+                            else 1
+                        )
+                        if now - entry.start_time > limit:
                             overdue.append(entry)
                             del self._inflight[rid]
                 for entry in overdue:
-                    self._add_strike(entry.worker_id, "task deadline exceeded")
+                    if entry.final_stage is None:
+                        # Chain entries carry the HEAD's id, but the stall
+                        # can be at any hop — striking (and eventually
+                        # quarantining) a possibly-healthy head for a hung
+                        # tail is wrong. Probes find the actual hung
+                        # worker; the replay below goes hub-path anyway.
+                        self._add_strike(
+                            entry.worker_id, "task deadline exceeded"
+                        )
                     self._forward_pool.submit(
                         self._redispatch, entry, "deadline exceeded"
                     )
@@ -881,9 +1076,19 @@ class Dispatcher:
             self._last_ok.pop(worker_id, None)
             self._probes.pop(worker_id, None)
             self._last_probe_id.pop(worker_id, None)
+        # A chain member's death breaks the chain for every in-flight
+        # chain request, whatever hop each is at — the hub only tracks
+        # the head, so orphan them all, now, not at deadline × stages.
+        with self._chain_lock:
+            in_chain = self._chain is not None and worker_id in self._chain
+        if in_chain:
+            self.disable_chain(f"chain member {worker_id} left")
         with self._inflight_lock:
             orphaned = [
-                e for e in self._inflight.values() if e.worker_id == worker_id
+                e
+                for e in self._inflight.values()
+                if e.worker_id == worker_id
+                or (in_chain and e.final_stage is not None)
             ]
             for e in orphaned:
                 del self._inflight[e.request_id]

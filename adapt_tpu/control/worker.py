@@ -61,6 +61,10 @@ class Task:
     stage_index: int
     attempt: int
     payload: Any  # host or device array
+    #: Chain-mode head submit (comm.remote chain forwarding): the result
+    #: returns on a DIFFERENT worker's link, so the receiving proxy must
+    #: not count it against its own in-flight depth.
+    chained: bool = False
 
 
 @dataclass

@@ -312,6 +312,166 @@ def test_remote_probe_roundtrip_and_hang_swallow():
         proc.wait(timeout=10)
 
 
+# -- chain forwarding (direct worker→worker data plane) ----------------------
+
+
+def _chain_pool(disp, cfg, cuts, ports):
+    """Spawn one worker process per port and attach dial-out proxies."""
+    from adapt_tpu.comm.remote import RemoteWorkerProxy
+
+    procs = [
+        spawn_worker_proc("--port", str(p), "--heartbeat", "0.1")
+        for p in ports
+    ]
+    proxies = []
+    for i, p in enumerate(ports):
+        pr = RemoteWorkerProxy(
+            f"chain-{i}",
+            ("127.0.0.1", p),
+            disp.registry,
+            disp.result_queue,
+            model_config={
+                "model": "vit_tiny",
+                "num_classes": 10,
+                "cuts": cuts,
+                "input_shape": [2, 32, 32, 3],
+            },
+            fault=cfg.fault,
+        )
+        disp.attach_worker(pr)
+        proxies.append(pr)
+    return procs, proxies
+
+
+def _chain_cfg():
+    from adapt_tpu.config import FaultConfig, ServeConfig
+
+    return ServeConfig(
+        fault=FaultConfig(
+            lease_ttl_s=2.0,
+            heartbeat_s=0.2,
+            task_deadline_s=30.0,
+            watchdog_period_s=0.2,
+            startup_wait_s=15.0,
+            configure_timeout_s=60.0,
+        )
+    )
+
+
+def test_chain_forwarding_bypasses_hub(devices):
+    """3 remote workers in chain mode: every intermediate activation hops
+    worker→worker (reference Gen-1 topology, ``src/node.py:163-179``);
+    the hub's links deliver ONLY the tail's final results, and outputs
+    equal the unpartitioned forward bit-for-bit (codec 'none')."""
+    from adapt_tpu.control.dispatcher import Dispatcher
+    from adapt_tpu.graph import partition
+    from adapt_tpu.models.vit import vit_block_cuts, vit_tiny
+
+    g = vit_tiny()
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = g.init(jax.random.PRNGKey(0), x)
+    cuts = vit_block_cuts(4, 3)
+    plan = partition(g, cuts)
+    y_ref = np.asarray(g.apply(variables, x))
+    cfg = _chain_cfg()
+    disp = Dispatcher(plan, variables, config=cfg)
+    procs, proxies = _chain_pool(disp, cfg, cuts, [17621, 17622, 17623])
+    try:
+        disp.start()
+        for pr in proxies:
+            pr.start()
+        order = disp.setup_chain([pr.worker_id for pr in proxies])
+        assert order == ["chain-0", "chain-1", "chain-2"]
+        outs = disp.serve_stream([x] * 6, timeout_per_request=120.0)
+        for y in outs:
+            np.testing.assert_allclose(
+                np.asarray(y), y_ref, rtol=1e-5, atol=1e-5
+            )
+        # The hub never touched an intermediate activation: the head and
+        # mid proxies delivered ZERO result frames; every result came in
+        # on the tail's link.
+        assert proxies[0].results_received == 0
+        assert proxies[1].results_received == 0
+        assert proxies[2].results_received == 6
+    finally:
+        disp.shutdown()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+
+
+def test_chain_failure_falls_back_to_hub_exactly_once(devices):
+    """Kill the MID-chain worker: the chain disables itself and serving
+    continues through the late-binding hub path on the survivors + local
+    workers — every request completes exactly once with the right
+    answer."""
+    from adapt_tpu.control.dispatcher import Dispatcher
+    from adapt_tpu.graph import partition
+    from adapt_tpu.models.vit import vit_block_cuts, vit_tiny
+
+    g = vit_tiny()
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = g.init(jax.random.PRNGKey(0), x)
+    cuts = vit_block_cuts(4, 3)
+    plan = partition(g, cuts)
+    y_ref = np.asarray(g.apply(variables, x))
+    cfg = _chain_cfg()
+    disp = Dispatcher(plan, variables, config=cfg)
+    # Local fallback capacity for after the kill.
+    disp.spawn_workers(devices[:2])
+    procs, proxies = _chain_pool(disp, cfg, cuts, [17631, 17632, 17633])
+    try:
+        disp.start()
+        for pr in proxies:
+            pr.start()
+        disp.setup_chain([pr.worker_id for pr in proxies])
+        outs = disp.serve_stream([x] * 2, timeout_per_request=120.0)
+        for y in outs:
+            np.testing.assert_allclose(
+                np.asarray(y), y_ref, rtol=1e-5, atol=1e-5
+            )
+        proxies[1].kill("crash")
+        # Membership notices (link drop -> deregister) and the chain
+        # disables itself.
+        deadline = time.monotonic() + 10.0
+        while disp._chain is not None:
+            assert time.monotonic() < deadline, "chain never disabled"
+            time.sleep(0.05)
+        outs2 = disp.serve_stream([x] * 4, timeout_per_request=120.0)
+        for y in outs2:
+            np.testing.assert_allclose(
+                np.asarray(y), y_ref, rtol=1e-5, atol=1e-5
+            )
+    finally:
+        disp.shutdown()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+
+
+def test_chain_rejects_in_process_workers(devices):
+    """Chaining is a cross-host topology; in-process workers share the
+    hub's memory, so setup_chain must refuse them loudly."""
+    from adapt_tpu.control.dispatcher import Dispatcher
+    from adapt_tpu.graph import partition
+    from adapt_tpu.models.vit import vit_tiny
+
+    g = vit_tiny()
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = g.init(jax.random.PRNGKey(0), x)
+    plan = partition(g, ["encoder_block_1"])
+    disp = Dispatcher(plan, variables)
+    workers = disp.spawn_workers(devices[:2])
+    disp.start()
+    try:
+        with pytest.raises(TypeError, match="cannot chain"):
+            disp.setup_chain([w.worker_id for w in workers])
+    finally:
+        disp.shutdown()
+
+
 # -- data-plane hardening ----------------------------------------------------
 
 
