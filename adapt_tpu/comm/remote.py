@@ -121,11 +121,18 @@ class RemoteStageServer:
         device_index: int = 0,
         heartbeat_s: float = 0.5,
         host: str = "127.0.0.1",
+        allow_registry: bool = True,
     ):
+        """``allow_registry=False`` — serve ONLY architecture-by-value
+        configures (``graph_spec`` in the header): the stance of a bare
+        worker image that ships the framework but no model zoo
+        (reference: any worker can ``model_from_json`` anything,
+        ``src/node.py:40-45``)."""
         self.port = port
         self.host = host
         self.device = jax.devices()[device_index]
         self.heartbeat_s = heartbeat_s
+        self.allow_registry = allow_registry
         self._graph_cache: dict[str, Any] = {}
         self._stages: dict[int, tuple[Any, Any]] = {}  # idx -> (fn, vars)
         self._stage_gen: dict[int, int] = {}  # idx -> installing generation
@@ -145,16 +152,22 @@ class RemoteStageServer:
         self._primary_reply = None
 
     def _build_stage(self, cfg: dict, leaves: list):
-        """Rebuild the named model, slice it, and load the stage weights
-        from the streamed per-array ``leaves`` (reference receiver:
-        ``src/node.py:101-119``, count-prefixed per-array frames)."""
+        """Rebuild the model — by REGISTRY NAME (shared model zoo) or by
+        VALUE (``graph_spec``: the serialized LayerGraph itself, so an
+        empty-registry worker can serve custom cuts/hyperparams/DAGs;
+        reference ``model_from_json``, ``src/node.py:40-45``) — slice it,
+        and load the stage weights from the streamed per-array ``leaves``
+        (reference receiver: ``src/node.py:101-119``, count-prefixed
+        per-array frames)."""
         from adapt_tpu.graph.partition import partition
-        from adapt_tpu.models import MODEL_REGISTRY
+        from adapt_tpu.graph.spec import graph_from_spec
 
         model_kwargs = cfg.get("model_kwargs", {})
+        graph_spec = cfg.get("graph_spec")
         key = json.dumps(
             [
-                cfg["model"],
+                cfg.get("model"),
+                graph_spec,
                 cfg.get("num_classes", 1000),
                 cfg["cuts"],
                 model_kwargs,
@@ -162,15 +175,30 @@ class RemoteStageServer:
             sort_keys=True,
         )
         if key not in self._graph_cache:
-            factory, default_shape = MODEL_REGISTRY[cfg["model"]]
-            # model_kwargs: extra factory arguments (e.g. resnet50's
-            # stem="s2d") — the joiner must rebuild the EXACT graph the
-            # dispatcher partitioned or the streamed weights won't fit.
-            graph = factory(
-                num_classes=cfg.get("num_classes", 1000), **model_kwargs
-            )
+            if graph_spec is not None:
+                graph = graph_from_spec(graph_spec)
+                input_shape = cfg.get("input_shape")
+                if input_shape is None:
+                    raise ValueError(
+                        "graph_spec configure needs an explicit input_shape"
+                    )
+            elif not self.allow_registry:
+                raise RuntimeError(
+                    "this worker serves architecture-by-value only "
+                    "(--no-registry); send a graph_spec, not a model name"
+                )
+            else:
+                from adapt_tpu.models import MODEL_REGISTRY
+
+                factory, default_shape = MODEL_REGISTRY[cfg["model"]]
+                # model_kwargs: extra factory arguments (e.g. resnet50's
+                # stem="s2d") — the joiner must rebuild the EXACT graph the
+                # dispatcher partitioned or the streamed weights won't fit.
+                graph = factory(
+                    num_classes=cfg.get("num_classes", 1000), **model_kwargs
+                )
+                input_shape = cfg.get("input_shape") or [1, *default_shape]
             plan = partition(graph, cfg["cuts"])
-            input_shape = cfg.get("input_shape") or [1, *default_shape]
             template = jax.eval_shape(
                 graph.init,
                 jax.random.PRNGKey(0),
@@ -1283,6 +1311,12 @@ def main() -> None:
         default=os.environ.get("ADAPT_TPU_GATEWAY_SECRET"),
         help="gateway join secret (or env ADAPT_TPU_GATEWAY_SECRET)",
     )
+    p.add_argument(
+        "--no-registry",
+        action="store_true",
+        help="bare-image stance: serve only architecture-by-value "
+        "(graph_spec) configures, never the local model registry",
+    )
     args = p.parse_args()
     if (args.port is None) == (args.connect is None):
         p.error("exactly one of --port / --connect is required")
@@ -1291,6 +1325,7 @@ def main() -> None:
         device_index=args.device_index,
         heartbeat_s=args.heartbeat,
         host=args.host,
+        allow_registry=not args.no_registry,
     )
     if args.connect is not None:
         host, _, port = args.connect.rpartition(":")

@@ -6,6 +6,7 @@ from adapt_tpu.graph.partition import (
     partition,
     valid_cut_points,
 )
+from adapt_tpu.graph.spec import graph_from_spec, graph_to_spec
 
 __all__ = [
     "INPUT",
@@ -16,4 +17,6 @@ __all__ = [
     "StageSpec",
     "partition",
     "valid_cut_points",
+    "graph_from_spec",
+    "graph_to_spec",
 ]

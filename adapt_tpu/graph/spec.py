@@ -1,0 +1,222 @@
+"""Architecture-by-value: (de)serialize a LayerGraph's STRUCTURE to JSON.
+
+The reference ships the model architecture itself to bare workers —
+``model.to_json()`` on the dispatcher (``/root/reference/src/dispatcher.py:
+235``), ``model_from_json`` worker-side (``src/node.py:40-45``) — so a
+worker needs no model code beyond the framework. The TPU-native analog:
+a :class:`~adapt_tpu.graph.ir.LayerGraph` is already a declared DAG of
+named flax-module nodes, and flax modules are dataclasses whose fields ARE
+the hyperparameters. The spec is therefore {node name, module import path,
+hyperparams, input edges} per node — everything needed to rebuild the
+graph on a worker whose model REGISTRY is empty (custom cuts, hand-built
+DAGs, and hyperparam variants all transfer by value).
+
+What does NOT transfer: code. Module classes import from the installed
+``adapt_tpu`` (or ``flax``) package on the worker — the same trust model
+as the reference, where Keras classes come from the worker's TF install.
+Imports are restricted to :data:`ALLOWED_MODULE_ROOTS` so a malicious
+spec cannot import arbitrary modules, and field values are data only
+(no pickles): callables/dtypes ride as registry names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any
+
+import jax
+import numpy as np
+
+from adapt_tpu.graph.ir import INPUT, Lambda, LayerGraph
+
+#: Merge-op vocabulary for :class:`Lambda` nodes (the reference's Keras
+#: ``Add``/``Concatenate`` analogs). A Lambda whose name is not here is
+#: not architecture-by-value serializable — callers get a loud error at
+#: SERIALIZE time, on the dispatcher, not at rebuild time on a worker.
+LAMBDA_REGISTRY: dict[str, Any] = {
+    "add": lambda a, b: a + b,
+    "add_relu": lambda shortcut, branch: jax.nn.relu(shortcut + branch),
+    "concat": lambda *xs: jax.numpy.concatenate(xs, axis=-1),
+    "identity": lambda x: x,
+}
+
+#: Activation-function vocabulary for ``Callable`` module fields
+#: (e.g. ``ConvBN.act``).
+ACT_REGISTRY: dict[str, Any] = {
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+    "gelu": jax.nn.gelu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jax.numpy.tanh,
+}
+
+#: Only these package roots may be imported while rebuilding a spec: the
+#: spec names classes, and an unrestricted dotted-path import would let a
+#: spec execute arbitrary module-level code.
+ALLOWED_MODULE_ROOTS = ("adapt_tpu.", "flax.linen")
+
+#: flax dataclass plumbing fields that are NOT hyperparameters.
+_FLAX_INTERNAL_FIELDS = frozenset({"parent", "name"})
+
+
+def registered_lambda(name: str) -> Lambda:
+    """The canonical way to build a serializable merge op: the Lambda
+    carries the REGISTRY's function object, which serialization verifies
+    by identity (a fresh ``lambda a, b: a + b`` named ``"add"`` would be
+    indistinguishable on the wire from a custom op wearing that name)."""
+    try:
+        return Lambda(LAMBDA_REGISTRY[name], name)
+    except KeyError:
+        raise KeyError(
+            f"no registered Lambda {name!r}; known: "
+            f"{sorted(LAMBDA_REGISTRY)}"
+        ) from None
+
+
+def _encode_value(v: Any) -> Any:
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (tuple, list)):
+        return {"__seq__": [_encode_value(x) for x in v],
+                "tuple": isinstance(v, tuple)}
+    if isinstance(v, dict):
+        return {"__map__": {k: _encode_value(x) for k, x in v.items()}}
+    for name, fn in ACT_REGISTRY.items():
+        if v is fn:
+            return {"__act__": name}
+    try:
+        return {"__dtype__": np.dtype(v).name}
+    except TypeError:
+        pass
+    raise TypeError(
+        f"cannot serialize module field value {v!r} "
+        "(architecture-by-value carries data, not code; register "
+        "callables in spec.ACT_REGISTRY)"
+    )
+
+
+def _decode_value(v: Any) -> Any:
+    if isinstance(v, dict):
+        if "__seq__" in v:
+            seq = [_decode_value(x) for x in v["__seq__"]]
+            return tuple(seq) if v.get("tuple") else seq
+        if "__map__" in v:
+            return {k: _decode_value(x) for k, x in v["__map__"].items()}
+        if "__act__" in v:
+            try:
+                return ACT_REGISTRY[v["__act__"]]
+            except KeyError:
+                raise ValueError(
+                    f"unknown activation {v['__act__']!r} in graph spec"
+                ) from None
+        if "__dtype__" in v:
+            return jax.numpy.dtype(v["__dtype__"])
+    return v
+
+
+def _module_to_spec(module: Any) -> dict:
+    if isinstance(module, Lambda):
+        if LAMBDA_REGISTRY.get(module.name) is not module._fn:
+            # Name-only matching would let a custom op wearing a registry
+            # name be silently REPLACED by the registry op worker-side —
+            # a numerically wrong rebuild with no error anywhere. The
+            # function object itself must come from the registry.
+            raise TypeError(
+                f"Lambda {module.name!r} does not carry the "
+                "spec.LAMBDA_REGISTRY function of that name; build merge "
+                "ops from the registry (spec.registered_lambda) to ship "
+                "by value"
+            )
+        return {"kind": "lambda", "name": module.name}
+    if dataclasses.is_dataclass(module):
+        cls = type(module)
+        path = f"{cls.__module__}.{cls.__qualname__}"
+        # Fail at SERIALIZE time (on the dispatcher) for anything the
+        # worker could never rebuild: classes outside the allowed roots
+        # (user scripts, __main__) and nested classes (the import path
+        # 'pkg.Outer.Inner' does not name a module attribute reachable
+        # from import_module('pkg.Outer')).
+        if not path.startswith(ALLOWED_MODULE_ROOTS):
+            raise TypeError(
+                f"cannot ship {path!r} by value: module classes must "
+                f"live under {ALLOWED_MODULE_ROOTS} on the worker image"
+            )
+        if "." in cls.__qualname__:
+            raise TypeError(
+                f"cannot ship nested class {path!r} by value: "
+                "define shipped modules at module top level"
+            )
+        config = {
+            f.name: _encode_value(getattr(module, f.name))
+            for f in dataclasses.fields(module)
+            if f.name not in _FLAX_INTERNAL_FIELDS
+        }
+        return {"kind": "flax", "type": path, "config": config}
+    raise TypeError(
+        f"cannot serialize module {module!r} (need a flax dataclass "
+        "module or a registered Lambda)"
+    )
+
+
+def _module_from_spec(spec: dict) -> Any:
+    kind = spec.get("kind")
+    if kind == "lambda":
+        name = spec["name"]
+        try:
+            return Lambda(LAMBDA_REGISTRY[name], name)
+        except KeyError:
+            raise ValueError(f"unknown Lambda {name!r} in graph spec") from None
+    if kind != "flax":
+        raise ValueError(f"unknown module kind {kind!r} in graph spec")
+    path = spec["type"]
+    if not path.startswith(ALLOWED_MODULE_ROOTS):
+        raise ValueError(
+            f"refusing to import {path!r}: graph specs may only name "
+            f"classes under {ALLOWED_MODULE_ROOTS}"
+        )
+    mod_path, _, clsname = path.rpartition(".")
+    obj: Any = getattr(importlib.import_module(mod_path), clsname)
+    # The resolved object must be a flax module CLASS: without this, any
+    # callable under an allowed root is a gadget a spec could invoke with
+    # chosen kwargs (e.g. a CLI main that SystemExits the serve thread).
+    import flax.linen as nn
+
+    if not (isinstance(obj, type) and issubclass(obj, nn.Module)):
+        raise ValueError(
+            f"{path!r} is not a flax module class; refusing to call it"
+        )
+    config = {k: _decode_value(v) for k, v in spec["config"].items()}
+    return obj(**config)
+
+
+def graph_to_spec(graph: LayerGraph) -> dict:
+    """JSON-serializable structure of ``graph`` (names, hyperparams,
+    edges — no weights; those stream separately per array, as always)."""
+    return {
+        "name": graph.name,
+        "output": graph.output,
+        "nodes": [
+            {
+                "name": node.name,
+                "inputs": list(node.inputs),
+                "module": _module_to_spec(node.module),
+            }
+            for node in graph.nodes.values()
+        ],
+    }
+
+
+def graph_from_spec(spec: dict) -> LayerGraph:
+    """Rebuild the LayerGraph a spec describes — the worker-side half
+    (reference ``model_from_json``, ``src/node.py:40-45``). Topological
+    node order is the list order, as :meth:`LayerGraph.add` requires."""
+    g = LayerGraph(spec["name"])
+    for node in spec["nodes"]:
+        g.add(
+            node["name"],
+            _module_from_spec(node["module"]),
+            tuple(node["inputs"]),
+        )
+    g.set_output(spec["output"])
+    return g

@@ -15,7 +15,8 @@ import string
 
 import jax.numpy as jnp
 
-from adapt_tpu.graph.ir import INPUT, LayerGraph, Lambda
+from adapt_tpu.graph.ir import INPUT, LayerGraph
+from adapt_tpu.graph.spec import registered_lambda
 from adapt_tpu.models.layers import (
     ClassifierHead,
     ConvBN,
@@ -83,7 +84,7 @@ def efficientnet(
                 # Identity residual: a real DAG join.
                 b = g.add(f"{blk}_branch", branch_mod, prev)
                 prev = g.add(
-                    f"{blk}_add", Lambda(lambda a, c: a + c, "add"), (prev, b)
+                    f"{blk}_add", registered_lambda("add"), (prev, b)
                 )
             else:
                 prev = g.add(blk, branch_mod, prev)

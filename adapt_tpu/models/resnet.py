@@ -33,7 +33,11 @@ _FILTERS = (64, 128, 256, 512)
 
 
 def _add_relu():
-    return Lambda(lambda shortcut, branch: jax.nn.relu(shortcut + branch), "add_relu")
+    # Registry-built so the graph ships by value (spec.py verifies merge
+    # ops by function identity, not name).
+    from adapt_tpu.graph.spec import registered_lambda
+
+    return registered_lambda("add_relu")
 
 
 def resnet(

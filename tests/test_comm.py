@@ -472,6 +472,85 @@ def test_chain_rejects_in_process_workers(devices):
         disp.shutdown()
 
 
+# -- architecture-by-value ---------------------------------------------------
+
+
+def test_registry_less_worker_serves_partitioned_resnet(devices):
+    """A worker started with --no-registry (bare image: framework, no
+    model zoo) serves a partitioned ResNet-50 configured entirely BY
+    VALUE — the serialized LayerGraph rides in MSG_CONFIG (reference
+    ``model.to_json()`` → ``model_from_json``, ``src/dispatcher.py:235``
+    / ``src/node.py:40-45``). A by-NAME configure to the same worker must
+    fail loudly."""
+    from adapt_tpu.comm.remote import RemoteWorkerProxy
+    from adapt_tpu.config import FaultConfig, ServeConfig
+    from adapt_tpu.control.dispatcher import Dispatcher
+    from adapt_tpu.graph import graph_to_spec, partition
+    from adapt_tpu.models.resnet import RESNET50_3STAGE_CUTS, resnet50
+
+    g = resnet50(num_classes=10)
+    x = jnp.ones((2, 64, 64, 3), jnp.float32)
+    variables = g.init(jax.random.PRNGKey(0), x)
+    cuts = list(RESNET50_3STAGE_CUTS)
+    plan = partition(g, cuts)
+    y_ref = np.asarray(g.apply(variables, x))
+
+    port = 17651
+    proc = spawn_worker_proc(
+        "--port", str(port), "--heartbeat", "0.2", "--no-registry"
+    )
+    cfg = ServeConfig(
+        fault=FaultConfig(
+            lease_ttl_s=2.0,
+            heartbeat_s=0.2,
+            task_deadline_s=60.0,
+            watchdog_period_s=0.5,
+            startup_wait_s=15.0,
+            configure_timeout_s=120.0,
+        )
+    )
+    disp = Dispatcher(plan, variables, config=cfg)
+    proxy = RemoteWorkerProxy(
+        "by-value-0",
+        ("127.0.0.1", port),
+        disp.registry,
+        disp.result_queue,
+        model_config={
+            "graph_spec": graph_to_spec(g),
+            "cuts": cuts,
+            "input_shape": [2, 64, 64, 3],
+        },
+        fault=cfg.fault,
+    )
+    disp.attach_worker(proxy)
+    disp.start()
+    try:
+        proxy.start()
+        # Configure ALL stages on the remote: every result the hub gets
+        # came from spec-rebuilt stages, none from local registry code.
+        for i in range(plan.num_stages):
+            proxy.configure(i, None, plan.extract_variables(variables)[i])
+        outs = disp.serve_stream([x] * 3, timeout_per_request=120.0)
+        for y in outs:
+            np.testing.assert_allclose(
+                np.asarray(y), y_ref, rtol=1e-5, atol=1e-5
+            )
+        assert proxy.results_received >= 3 * plan.num_stages
+        # By-name configure against the bare worker: loud refusal.
+        proxy._model_config = {
+            "model": "resnet50",
+            "num_classes": 10,
+            "cuts": cuts,
+            "input_shape": [2, 64, 64, 3],
+        }
+        with pytest.raises(RuntimeError, match="architecture-by-value"):
+            proxy.configure(0, None, plan.extract_variables(variables)[0])
+    finally:
+        disp.shutdown()
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
 # -- data-plane hardening ----------------------------------------------------
 
 

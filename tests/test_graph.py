@@ -184,3 +184,76 @@ def test_compose_length_mismatch(graph_and_vars):
     sv = plan.extract_variables(variables)
     with pytest.raises(ValueError, match="stale plan"):
         plan.compose(sv[:1], x)
+
+
+# -- architecture-by-value specs ---------------------------------------------
+
+
+def _roundtrip(graph, x):
+    """graph -> JSON -> graph; prove structural identity by running the
+    ORIGINAL variables through the rebuilt graph (same node names, same
+    module hyperparams => same variable trees, same outputs)."""
+    import json
+
+    import jax
+
+    from adapt_tpu.graph.spec import graph_from_spec, graph_to_spec
+
+    spec = json.loads(json.dumps(graph_to_spec(graph)))  # full wire trip
+    rebuilt = graph_from_spec(spec)
+    assert rebuilt.topo_order() == graph.topo_order()
+    assert rebuilt.output == graph.output
+    variables = graph.init(jax.random.PRNGKey(0), x)
+    y_ref = graph.apply(variables, x)
+    y = rebuilt.apply(variables, x)
+    np.testing.assert_array_equal(np.asarray(y_ref), np.asarray(y))
+    return rebuilt
+
+
+def test_spec_roundtrip_vit_tiny():
+    from adapt_tpu.models.vit import vit_tiny
+
+    _roundtrip(vit_tiny(), jnp.ones((1, 32, 32, 3), jnp.float32))
+
+
+def test_spec_roundtrip_resnet50():
+    from adapt_tpu.models.resnet import resnet50
+
+    # bf16 + s2d stem: dtype fields and stem variants must ship by value.
+    _roundtrip(
+        resnet50(num_classes=10, dtype=jnp.bfloat16, stem="s2d"),
+        jnp.ones((1, 64, 64, 3), jnp.float32),
+    )
+
+
+def test_spec_roundtrip_efficientnet_b0():
+    from adapt_tpu.models.efficientnet import efficientnet_b0
+
+    # Exercises Callable act fields, float ratios and the "add" Lambda.
+    _roundtrip(
+        efficientnet_b0(num_classes=10), jnp.ones((1, 64, 64, 3), jnp.float32)
+    )
+
+
+def test_spec_rejects_unknown_lambda_and_foreign_imports():
+    from adapt_tpu.graph.ir import Lambda, LayerGraph
+    from adapt_tpu.graph.spec import graph_from_spec, graph_to_spec
+
+    g = LayerGraph("bad")
+    g.add("mystery", Lambda(lambda x: x * 3, "triple"))
+    with pytest.raises(TypeError, match="LAMBDA_REGISTRY"):
+        graph_to_spec(g)
+
+    hostile = {
+        "name": "evil",
+        "output": "n",
+        "nodes": [
+            {
+                "name": "n",
+                "inputs": ["__input__"],
+                "module": {"kind": "flax", "type": "os.system", "config": {}},
+            }
+        ],
+    }
+    with pytest.raises(ValueError, match="refusing to import"):
+        graph_from_spec(hostile)
