@@ -718,6 +718,12 @@ class RemoteWorkerProxy:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "RemoteWorkerProxy":
+        # Idempotent: recovery dials proxies one by one (skipping the
+        # unreachable) before Dispatcher.start() walks the pool calling
+        # start() again — a second call must not stack a second reader
+        # thread or lease.
+        if self._reader is not None:
+            return self
         if self._sock is None:
             deadline = time.monotonic() + self._fault.startup_wait_s
             last: Exception | None = None

@@ -116,9 +116,18 @@ class Dispatcher:
         variables,
         registry: WorkerRegistry | None = None,
         config: ServeConfig | None = None,
+        journal=None,
     ):
+        """``journal`` — optional :class:`~adapt_tpu.control.journal.
+        DispatcherJournal`: accepted requests and the dial-out worker
+        table survive a dispatcher crash, and :meth:`recover` rebuilds a
+        serving dispatcher from them (the reference's
+        etcd-outlives-the-dispatcher property, ``src/start_etcd.sh:81-94``,
+        re-scoped per SURVEY §7.5). Journaling an accepted request costs
+        one host fetch + fsync per submit."""
         self.plan = plan
         self.config = config or ServeConfig()
+        self._journal = journal
         self.registry = registry or WorkerRegistry(
             default_ttl_s=self.config.fault.lease_ttl_s
         )
@@ -224,6 +233,23 @@ class Dispatcher:
     def attach_worker(self, worker: StageWorker) -> None:
         with self._workers_lock:
             self._workers[worker.worker_id] = worker
+        # Dial-out remote proxies are re-adoptable after a dispatcher
+        # crash (their server keeps listening); journal their address +
+        # configure recipe. In-process workers die with this process and
+        # gateway joiners redial on their own, so neither is journaled.
+        if self._journal is not None:
+            addr = getattr(worker, "chain_address", None)
+            if addr is not None:
+                self._journal.record_worker(
+                    worker.worker_id,
+                    addr[0],
+                    addr[1],
+                    meta={
+                        "model_config": worker._model_config,
+                        "codec": worker._codec_name,
+                        "weights_codec": worker._wcodec.name,
+                    },
+                )
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -258,6 +284,131 @@ class Dispatcher:
         self._started = True
         return self
 
+    def hard_stop(self) -> None:
+        """Crash simulation (tests / chaos drills): abandon everything
+        NOW — no draining, no future completion, no journal marks, no
+        deregistration. The process state a SIGKILL leaves behind, minus
+        the process exit. Worker processes keep running and listening;
+        :meth:`recover` is the other half."""
+        self._shutdown.set()
+        self.result_queue.put(None)  # type: ignore[arg-type]
+        with self._workers_lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            sock = getattr(w, "_sock", None)
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        self._forward_pool.shutdown(wait=False, cancel_futures=True)
+        self._prewarm_pool.shutdown(wait=False, cancel_futures=True)
+        self.registry.stop()
+
+    @classmethod
+    def recover(
+        cls,
+        plan: PartitionPlan,
+        variables,
+        journal,
+        config: ServeConfig | None = None,
+    ) -> tuple["Dispatcher", dict[int, PipelineFuture]]:
+        """Rebuild a serving dispatcher from a crashed one's journal.
+
+        Re-adopts every journaled dial-out worker whose server still
+        answers (unreachable addresses are skipped with a warning — the
+        pool heals, it doesn't block), seeds the request-id counter past
+        every journaled id, and REPLAYS each submitted-but-never-completed
+        request from its retained payload. Returns ``(dispatcher,
+        {request_id: future})`` for the replayed requests — each completes
+        exactly once. Replay respects ``max_inflight`` and so may block
+        admitting the tail of a large backlog while the head completes.
+
+        Model/weights come from ``plan``/``variables`` (the operator's
+        checkpoint, ``utils/checkpoint.py``) — the journal owns
+        control-plane state only."""
+        from adapt_tpu.comm.remote import RemoteWorkerProxy
+
+        workers, pending, next_id = journal.load()
+        disp = cls(plan, variables, config=config, journal=journal)
+        disp._req_ids = itertools.count(next_id)
+        attached = 0
+        proxies = []
+        for worker_id, info in workers.items():
+            meta = info.get("meta", {})
+            proxy = RemoteWorkerProxy(
+                worker_id,
+                (info["host"], info["port"]),
+                disp.registry,
+                disp.result_queue,
+                model_config=meta.get("model_config", {}),
+                codec_name=meta.get("codec", "none"),
+                weights_codec=meta.get("weights_codec", "lz"),
+                fault=disp.config.fault,
+            )
+            disp.attach_worker(proxy)
+            proxies.append(proxy)
+            attached += 1
+        if not attached:
+            raise RequestFailed(
+                "journal holds no re-adoptable workers; nothing to recover"
+            )
+        # start() dials every attached proxy; a dead address raises from
+        # its start() — dial here instead, CONCURRENTLY (a pool with dead
+        # addresses must not serialize startup_wait_s stalls), pruning
+        # the unreachable from the journal so they never stall another
+        # recovery (re-attaching a revived worker re-journals it).
+        with disp._workers_lock:
+            disp._workers.clear()
+        alive = []
+
+        def _dial(proxy):
+            try:
+                proxy.start()
+                return proxy, None
+            except Exception as e:  # noqa: BLE001
+                return proxy, e
+
+        with ThreadPoolExecutor(
+            max_workers=min(16, max(1, len(proxies))),
+            thread_name_prefix="recover-dial",
+        ) as pool:
+            for proxy, err in pool.map(_dial, proxies):
+                if err is not None:
+                    log.warning(
+                        "recovery: worker %s at %s not re-adoptable (%s); "
+                        "pruned from the journal",
+                        proxy.worker_id,
+                        proxy.address,
+                        err,
+                    )
+                    journal.forget_worker(proxy.worker_id)
+                    continue
+                with disp._workers_lock:
+                    disp._workers[proxy.worker_id] = proxy
+                alive.append(proxy)
+        if not alive:
+            raise RequestFailed(
+                "no journaled worker answered; cannot recover the pool"
+            )
+        disp.start()
+        futures: dict[int, PipelineFuture] = {}
+        for rid, payload in pending.items():
+            disp._sem.acquire()
+            future = PipelineFuture(rid)
+            futures[rid] = future
+            try:
+                disp._dispatch(rid, 0, payload, future, attempt=0, retries=0)
+            except Exception as e:  # noqa: BLE001
+                disp._finish(future, error=str(e))
+        log.info(
+            "recovered: %d workers re-adopted, %d requests replayed",
+            len(alive),
+            len(futures),
+        )
+        global_metrics().inc("dispatcher.recovered", 1)
+        return disp, futures
+
     def shutdown(self) -> None:
         self._shutdown.set()
         self.result_queue.put(None)  # type: ignore[arg-type]
@@ -289,6 +440,15 @@ class Dispatcher:
         self._sem.acquire()
         request_id = next(self._req_ids)
         future = PipelineFuture(request_id)
+        if self._journal is not None:
+            # Write-ahead, strictly before dispatch: with journaling on,
+            # an accepted request must be recoverable — a submit the
+            # journal can't record is refused, not silently volatile.
+            try:
+                self._journal.record_submit(request_id, x)
+            except Exception as e:
+                self._sem.release()
+                raise RequestFailed(f"journal write failed: {e}") from e
         try:
             self._dispatch(request_id, 0, x, future, attempt=0, retries=0)
         except Exception as e:  # no worker at all -> fail fast
@@ -775,6 +935,18 @@ class Dispatcher:
 
     def _finish(self, future: PipelineFuture, value=None, error=None) -> None:
         if future._complete(value, error):
+            if self._journal is not None:
+                # Terminal either way (value OR reported error): replay
+                # is for requests that never completed. A crash before
+                # this mark replays the request once — the documented
+                # at-least-once window.
+                try:
+                    self._journal.record_done(future.request_id)
+                except Exception:  # noqa: BLE001 — worst case: one replay
+                    log.warning(
+                        "journal done-mark failed for request %d",
+                        future.request_id,
+                    )
             self._sem.release()
             global_metrics().inc(
                 "dispatcher.completed" if error is None else "dispatcher.failed"

@@ -1,0 +1,242 @@
+"""Dispatcher write-ahead journal: membership + in-flight work that
+OUTLIVES the dispatcher process.
+
+The reference keeps membership in an etcd *server* whose lifetime is
+independent of the dispatcher (``/root/reference/src/start_etcd.sh:81-94``;
+worker keys ``src/node_state.py:16-20``) — a dispatcher restart rediscovers
+the pool from etcd. Managing an etcd server is a declared non-goal
+(SURVEY §7.5); what this module rebuilds is the *semantics that matter*:
+after a dispatcher crash, a fresh process can (a) re-adopt the worker pool
+and (b) replay every request that was accepted but never completed —
+exactly once each from the client's view.
+
+Design: an append-only JSONL WAL (`wal.jsonl`) for worker records and
+request submit/done marks, with request payloads as individual `.npy`
+files written atomically (tmp + rename) BEFORE their submit mark — a
+submit mark therefore always has its payload. `record_done` appends on
+ANY terminal completion (value or error): replay is for requests that
+never completed, not for retrying failures the old dispatcher already
+reported. Worker weights are NOT journaled — stage weights re-stream from
+the model variables the new dispatcher is constructed with (the
+checkpoint layer, ``utils/checkpoint.py``, owns model state; the journal
+owns control-plane state).
+
+The WAL is self-compacting: a live mirror of {workers, pending ids}
+rides in memory, and every ``compact_every`` appends (and every
+:meth:`load`) the file is rewritten to just that state — journal size
+and recovery time are bounded by LIVE state, not all-time history.
+
+At-least-once window, stated honestly: a crash BETWEEN a future's
+completion and its done mark replays that request once more on recovery
+(standard WAL semantics). Within one dispatcher's life, completion is
+exactly-once (request ids + attempt tags); across a crash, each pending
+request completes exactly once in the recovered dispatcher.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any
+
+import numpy as np
+
+from adapt_tpu.utils.logging import get_logger
+
+log = get_logger("journal")
+
+
+class DispatcherJournal:
+    """Append-only crash journal under ``root``. Thread-safe; every
+    append is flushed + fsynced (a journal that loses its tail to the
+    page cache would silently drop requests on a host crash)."""
+
+    def __init__(self, root: str, compact_every: int = 10_000):
+        self.root = root
+        self.compact_every = compact_every
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._wal_path = os.path.join(root, "wal.jsonl")
+        # Live mirror (rebuilt from the file on open): what a compaction
+        # writes, and what keeps compaction O(live state) not O(history).
+        self._workers: dict[str, dict] = {}
+        self._pending: set[int] = set()
+        self._max_id = -1
+        self._appends = 0
+        self._replay_file_into_mirror()
+        self._wal = open(self._wal_path, "a", encoding="utf-8")
+
+    # -- write side ----------------------------------------------------------
+
+    def _apply_to_mirror(self, rec: dict) -> None:
+        op = rec.get("op")
+        if op == "worker":
+            self._workers[rec["id"]] = {
+                "host": rec["host"],
+                "port": rec["port"],
+                "meta": rec.get("meta", {}),
+            }
+        elif op == "worker_gone":
+            self._workers.pop(rec["id"], None)
+        elif op == "submit":
+            self._pending.add(rec["id"])
+            self._max_id = max(self._max_id, rec["id"])
+        elif op == "done":
+            self._pending.discard(rec["id"])
+            self._max_id = max(self._max_id, rec["id"])
+        elif op == "horizon":
+            # Compaction's id-watermark record: keeps next_request_id
+            # monotone across rewrites without implying any completion.
+            self._max_id = max(self._max_id, rec["id"])
+
+    def _replay_file_into_mirror(self) -> None:
+        if not os.path.exists(self._wal_path):
+            return
+        with open(self._wal_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    # A torn final line is the expected crash shape: its
+                    # payload (if any) was orphaned pre-mark and is
+                    # ignored; everything before it is intact.
+                    log.warning("journal: skipping torn WAL line")
+                    continue
+                self._apply_to_mirror(rec)
+
+    def _append(self, record: dict) -> None:
+        with self._lock:
+            self._wal.write(json.dumps(record) + "\n")
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+            self._apply_to_mirror(record)
+            self._appends += 1
+            if self._appends >= self.compact_every:
+                self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        """Rewrite the WAL as {current workers} + {pending submit marks}
+        — atomic (tmp + rename), then reopen for append."""
+        tmp = self._wal_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for wid, info in self._workers.items():
+                f.write(
+                    json.dumps(
+                        {
+                            "op": "worker",
+                            "id": wid,
+                            "host": info["host"],
+                            "port": info["port"],
+                            "meta": info.get("meta", {}),
+                        }
+                    )
+                    + "\n"
+                )
+            for rid in sorted(self._pending):
+                f.write(json.dumps({"op": "submit", "id": rid}) + "\n")
+            # Preserve the id horizon across compaction: recycled request
+            # ids would break done-mark bookkeeping after recovery. A
+            # dedicated record type — a "done" mark here would falsely
+            # complete max_id if it is itself still pending.
+            f.write(json.dumps({"op": "horizon", "id": self._max_id}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        old = self._wal
+        os.replace(tmp, self._wal_path)
+        self._wal = open(self._wal_path, "a", encoding="utf-8")
+        try:
+            old.close()
+        except OSError:
+            pass
+        self._appends = 0
+
+    def compact(self) -> None:
+        with self._lock:
+            self._compact_locked()
+
+    def record_worker(
+        self, worker_id: str, host: str, port: int, meta: dict | None = None
+    ) -> None:
+        """Durable worker-pool entry (the reference's ``/workers/<ip>``
+        etcd key). Latest record per id wins on load."""
+        self._append(
+            {
+                "op": "worker",
+                "id": worker_id,
+                "host": host,
+                "port": port,
+                "meta": meta or {},
+            }
+        )
+
+    def forget_worker(self, worker_id: str) -> None:
+        """Remove a worker from the durable pool — called when recovery
+        finds its address dead (and available for administrative
+        decommission). NOT lease expiry: a transiently-dead worker should
+        survive a dispatcher restart; re-attaching re-journals it."""
+        self._append({"op": "worker_gone", "id": worker_id})
+
+    def _payload_path(self, request_id: int) -> str:
+        return os.path.join(self.root, f"req_{request_id}.npy")
+
+    def record_submit(self, request_id: int, payload: Any) -> None:
+        """Payload first (atomic rename), THEN the submit mark: the WAL
+        never references bytes that aren't durably there."""
+        path = self._payload_path(request_id)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.save(f, np.asarray(payload), allow_pickle=False)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._append({"op": "submit", "id": request_id})
+
+    def record_done(self, request_id: int) -> None:
+        self._append({"op": "done", "id": request_id})
+        try:  # payload no longer needed; best-effort space reclaim
+            os.unlink(self._payload_path(request_id))
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._wal.close()
+            except OSError:
+                pass
+
+    # -- read side (recovery) ------------------------------------------------
+
+    def load(self) -> tuple[dict[str, dict], dict[int, np.ndarray], int]:
+        """Recovery snapshot: ``(workers, pending, next_request_id)``
+        where ``workers`` maps worker_id -> {host, port, meta} (latest
+        record wins), ``pending`` maps request_id -> payload for every
+        submit without a done mark, and ``next_request_id`` is one past
+        the highest id ever journaled (the recovered dispatcher's counter
+        seed). A pending mark whose payload is unreadable is marked done
+        (it cannot ever be replayed — rescanning it forever would only
+        re-log the same loss) and reported loudly. Compacts the WAL as a
+        side effect: recovery is the natural history-truncation point."""
+        with self._lock:
+            workers = {k: dict(v) for k, v in self._workers.items()}
+            pending_ids = sorted(self._pending)
+            next_id = self._max_id + 1
+        pending: dict[int, np.ndarray] = {}
+        for rid in pending_ids:
+            path = self._payload_path(rid)
+            try:
+                pending[rid] = np.load(path, allow_pickle=False)
+            except OSError as e:
+                log.error(
+                    "journal: request %d has a submit mark but no "
+                    "readable payload (%s); it is LOST and marked done",
+                    rid,
+                    e,
+                )
+                self.record_done(rid)
+        self.compact()
+        return workers, pending, next_id
