@@ -290,6 +290,11 @@ class Dispatcher:
         deregistration. The process state a SIGKILL leaves behind, minus
         the process exit. Worker processes keep running and listening;
         :meth:`recover` is the other half."""
+        # Detach the journal FIRST: in-flight forward/result threads
+        # erroring on the closed sockets must not write done marks a real
+        # SIGKILL could never write (each would silently shrink the
+        # recovery replay set).
+        self._journal = None
         self._shutdown.set()
         self.result_queue.put(None)  # type: ignore[arg-type]
         with self._workers_lock:

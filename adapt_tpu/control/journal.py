@@ -108,11 +108,23 @@ class DispatcherJournal:
                     continue
                 self._apply_to_mirror(rec)
 
-    def _append(self, record: dict) -> None:
+    def _fsync_root(self) -> None:
+        """Durable-rename half: fsyncing a renamed FILE does not persist
+        the rename itself — the DIRECTORY entry must also reach disk, or
+        a host crash reverts the rename (losing a payload, or worse,
+        reverting a compaction and losing every record after it)."""
+        fd = os.open(self.root, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def _append(self, record: dict, fsync: bool = True) -> None:
         with self._lock:
             self._wal.write(json.dumps(record) + "\n")
             self._wal.flush()
-            os.fsync(self._wal.fileno())
+            if fsync:
+                os.fsync(self._wal.fileno())
             self._apply_to_mirror(record)
             self._appends += 1
             if self._appends >= self.compact_every:
@@ -147,12 +159,27 @@ class DispatcherJournal:
             os.fsync(f.fileno())
         old = self._wal
         os.replace(tmp, self._wal_path)
+        self._fsync_root()
         self._wal = open(self._wal_path, "a", encoding="utf-8")
         try:
             old.close()
         except OSError:
             pass
         self._appends = 0
+        # Payload GC: sweep files the live pending set no longer
+        # references (failed-submit leftovers, unlink-after-done misses,
+        # pre-mark crash orphans) — disk stays bounded like the WAL.
+        live = {f"req_{rid}.npy" for rid in self._pending}
+        for name in os.listdir(self.root):
+            if (
+                name.startswith("req_")
+                and name.endswith((".npy", ".npy.tmp"))
+                and name not in live
+            ):
+                try:
+                    os.unlink(os.path.join(self.root, name))
+                except OSError:
+                    pass
 
     def compact(self) -> None:
         with self._lock:
@@ -193,10 +220,15 @@ class DispatcherJournal:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        self._fsync_root()  # the rename itself must survive a host crash
         self._append({"op": "submit", "id": request_id})
 
     def record_done(self, request_id: int) -> None:
-        self._append({"op": "done", "id": request_id})
+        # No fsync: a done mark lost to the page cache costs exactly one
+        # extra replay (the documented at-least-once window), and the
+        # mark rides the hot completion path — fsyncing it would cap
+        # throughput at disk latency for zero added guarantee.
+        self._append({"op": "done", "id": request_id}, fsync=False)
         try:  # payload no longer needed; best-effort space reclaim
             os.unlink(self._payload_path(request_id))
         except OSError:

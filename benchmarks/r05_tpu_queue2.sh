@@ -1,0 +1,27 @@
+#!/bin/bash
+# Round-5 TPU queue, run 2 — evidence for the decode-MBU gap accounting
+# (VERDICT r4 #4) + the remaining serving rows. Run AFTER r05_tpu_queue.sh.
+# Serial by design: NEVER two JAX processes through the relay at once.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/results/r05
+mkdir -p "$OUT"
+log() { echo "=== $(date +%H:%M:%S) $*"; }
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0
+export JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES=-1
+export BENCH_ROUND=r05
+
+log "1. decode trace: short context (the MBU 0.43 row's gap accounting)"
+timeout 1800 python benchmarks/lm_decode_profile.py \
+  | tail -1 | tee -a "$OUT/lm_decode_profile.json"
+
+log "2. decode trace: 2k context (the MBU 0.32 row)"
+timeout 1800 python benchmarks/lm_decode_profile.py --prompt 1024 \
+  --maxlen 2048 --out "$OUT/trace_decode_2k" | tail -1 \
+  | tee -a "$OUT/lm_decode_profile_2k.json"
+
+log "3. speculative decoding on-chip row"
+timeout 1800 python benchmarks/speculative_decode.py | tail -1
+
+log "queue2 done"

@@ -41,6 +41,16 @@ Breakdown per trial (also written to ``--out`` as a JSON artifact):
               per-stage TPU chips that replay is milliseconds, so
               detect+rebind+overhead is the hardware-transferable number.
 
+Phase attribution (r4 verdict #8: one r04 trial carried overhead_s=2.6
+against a <2 s budget with no diagnosis): each trial also records every
+configure's (start, duration, worker, stage) after the kill, the
+dispatcher counter deltas over the kill burst (redispatched / stale /
+deadline strikes — was the overhead a replay storm?), accumulated GC
+pause seconds inside the burst (was it the collector?), and the
+completion watermarks' largest gap (was it ONE straggler request, e.g. a
+second replay after a task deadline?). An outlier trial is then
+attributable from the artifact alone instead of deserving a shrug.
+
 Prints one JSON line; vs_baseline = 2.0 / median_total_s (>1 beats the
 <2 s target).
 """
@@ -93,8 +103,13 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default="vit-tiny", choices=sorted(CONFIGS))
     parser.add_argument("--out", default=None, help="write per-trial JSON here")
+    parser.add_argument(
+        "--trials", type=int, default=None, help="override the config's trials"
+    )
     args = parser.parse_args()
     n_devices, n_stages, burst, trials = CONFIGS[args.config]
+    if args.trials is not None:
+        trials = args.trials
 
     force_cpu_mesh(n_devices)
     import jax
@@ -151,9 +166,10 @@ def main() -> None:
                 def timed(
                     *a, _orig=orig, _w=w, _ev=events, **kw
                 ):
+                    t_start = time.monotonic()
                     r = _orig(*a, **kw)
                     _ev["configures"].append(
-                        (time.monotonic(), _w.worker_id, a[0])
+                        (t_start, time.monotonic(), _w.worker_id, a[0])
                     )
                     return r
 
@@ -172,6 +188,25 @@ def main() -> None:
 
             xs = distinct_inputs(
                 jax.random.PRNGKey(100 + trial), x0.shape, burst
+            )
+            # Phase-attribution hooks for THIS burst: GC pauses and
+            # dispatcher counters over exactly the kill window.
+            import gc
+
+            gc_pause = {"s": 0.0, "t0": None}
+
+            def on_gc(phase, info, _g=gc_pause):
+                if phase == "start":
+                    _g["t0"] = time.monotonic()
+                elif _g["t0"] is not None:
+                    _g["s"] += time.monotonic() - _g["t0"]
+                    _g["t0"] = None
+
+            gc.callbacks.append(on_gc)
+            from adapt_tpu.utils.metrics import global_metrics
+
+            counters_before = dict(
+                global_metrics().snapshot()["counters"]
             )
             t_submit = time.monotonic()
             futures = [pipe.dispatcher.submit(x) for x in xs]
@@ -193,9 +228,16 @@ def main() -> None:
                 )
             t0 = time.monotonic()
             victim.kill("crash")
+            # Completion watermarks: result() in submit order gives a
+            # non-decreasing drain curve; its largest gap fingers a
+            # straggler (a request replayed late) vs uniform slowdown.
+            watermarks = []
             for f in futures:
                 f.result(timeout=300.0)
+                watermarks.append(time.monotonic())
             t_done = time.monotonic()
+            gc.callbacks.remove(on_gc)
+            counters_after = global_metrics().snapshot()["counters"]
             total = t_done - t0
             detect = next(
                 (
@@ -205,8 +247,28 @@ def main() -> None:
                 ),
                 None,
             )
-            post_kill = [t for (t, _, _) in events["configures"] if t > t0]
-            rebind = (min(post_kill) - t0) if post_kill else None
+            post_kill = [
+                (start, end, wid, stage)
+                for (start, end, wid, stage) in events["configures"]
+                if end > t0
+            ]
+            rebind = (
+                (min(end for (_, end, _, _) in post_kill) - t0)
+                if post_kill
+                else None
+            )
+            deltas = {
+                k: counters_after.get(k, 0) - counters_before.get(k, 0)
+                for k in (
+                    "dispatcher.redispatched",
+                    "dispatcher.stale_results",
+                    "dispatcher.tasks_sent",
+                    "dispatcher.probes_ok",
+                )
+            }
+            gaps = [
+                b - a for a, b in zip(watermarks, watermarks[1:])
+            ]
             trials_out.append(
                 {
                     "trial": trial,
@@ -216,6 +278,21 @@ def main() -> None:
                     "total_s": total,
                     "control_s": control_s,
                     "overhead_s": (t_done - t_submit) - control_s,
+                    # -- phase attribution --
+                    "post_kill_configures": [
+                        {
+                            "at_s": round(start - t0, 4),
+                            "dur_s": round(end - start, 4),
+                            "worker": wid,
+                            "stage": stage,
+                        }
+                        for (start, end, wid, stage) in sorted(post_kill)
+                    ],
+                    "counter_deltas": deltas,
+                    "gc_pause_s": round(gc_pause["s"], 4),
+                    "max_completion_gap_s": round(max(gaps), 4)
+                    if gaps
+                    else 0.0,
                 }
             )
         finally:
