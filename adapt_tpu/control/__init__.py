@@ -1,9 +1,11 @@
 from adapt_tpu.control.dispatcher import Dispatcher, RequestFailed
+from adapt_tpu.control.journal import DispatcherJournal
 from adapt_tpu.control.registry import WorkerRegistry
 from adapt_tpu.control.worker import StageWorker, WorkerState
 
 __all__ = [
     "Dispatcher",
+    "DispatcherJournal",
     "RequestFailed",
     "WorkerRegistry",
     "StageWorker",

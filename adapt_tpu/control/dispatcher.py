@@ -155,7 +155,13 @@ class Dispatcher:
         self._inflight: dict[int, _Inflight] = {}
         self._inflight_lock = threading.Lock()
         self._sem = threading.Semaphore(self.config.max_inflight)
-        self._req_ids = itertools.count()
+        # Over a pre-existing journal, start past every id it has seen:
+        # a fresh counter would recycle journaled ids — overwriting a
+        # crashed request's payload and clearing its pending mark with
+        # the new request's done.
+        self._req_ids = itertools.count(
+            journal.next_request_id if journal is not None else 0
+        )
         self._watchdog_paused = False
         # Strike-based quarantine: a worker that keeps missing task
         # deadlines while heartbeating (a hang) is never evicted by lease
@@ -334,9 +340,9 @@ class Dispatcher:
         control-plane state only."""
         from adapt_tpu.comm.remote import RemoteWorkerProxy
 
-        workers, pending, next_id = journal.load()
+        workers, pending, _ = journal.load()
+        # cls() seeds the request-id counter from the journal's horizon.
         disp = cls(plan, variables, config=config, journal=journal)
-        disp._req_ids = itertools.count(next_id)
         attached = 0
         proxies = []
         for worker_id, info in workers.items():
@@ -433,6 +439,8 @@ class Dispatcher:
         for w in workers:
             w.stop()
         self.registry.stop()
+        if self._journal is not None:
+            self._journal.close()
 
     # -- request API --------------------------------------------------------
 

@@ -62,6 +62,12 @@ class DispatcherJournal:
         # writes, and what keeps compaction O(live state) not O(history).
         self._workers: dict[str, dict] = {}
         self._pending: set[int] = set()
+        #: ids whose payload write is in flight (reserved in
+        #: record_submit BEFORE the file appears): the compaction sweep
+        #: must not reap a payload whose submit mark hasn't landed yet.
+        self._writing: set[int] = set()
+        #: done-marked ids whose payloads await group-commit reclaim.
+        self._reclaimable: list[int] = []
         self._max_id = -1
         self._appends = 0
         self._replay_file_into_mirror()
@@ -89,6 +95,15 @@ class DispatcherJournal:
             # Compaction's id-watermark record: keeps next_request_id
             # monotone across rewrites without implying any completion.
             self._max_id = max(self._max_id, rec["id"])
+
+    @property
+    def next_request_id(self) -> int:
+        """One past the highest id this journal has ever seen — the seed
+        for any dispatcher serving over this journal (a fresh counter
+        would recycle ids and silently clear crashed-but-unreplayed
+        requests with its done marks)."""
+        with self._lock:
+            return self._max_id + 1
 
     def _replay_file_into_mirror(self) -> None:
         if not os.path.exists(self._wal_path):
@@ -166,15 +181,26 @@ class DispatcherJournal:
         except OSError:
             pass
         self._appends = 0
-        # Payload GC: sweep files the live pending set no longer
-        # references (failed-submit leftovers, unlink-after-done misses,
-        # pre-mark crash orphans) — disk stays bounded like the WAL.
-        live = {f"req_{rid}.npy" for rid in self._pending}
+        # Every done mark is now durable (the compacted file simply has
+        # no pending mark for those ids), so the sweep below may reclaim
+        # the whole backlog.
+        self._reclaimable.clear()
+        # Payload GC: sweep files neither the live pending set nor an
+        # in-flight submit references (failed-submit leftovers, done
+        # payloads, pre-mark crash orphans) — disk stays bounded like
+        # the WAL. Payload reclamation for completed requests happens
+        # HERE, not in record_done: the mark is un-fsynced there, and an
+        # unlink whose directory metadata beats the page-cached mark to
+        # disk would turn "one extra replay" into a falsely-LOST request.
+        keep = set()
+        for rid in self._pending | self._writing:
+            keep.add(f"req_{rid}.npy")
+            keep.add(f"req_{rid}.npy.tmp")
         for name in os.listdir(self.root):
             if (
                 name.startswith("req_")
                 and name.endswith((".npy", ".npy.tmp"))
-                and name not in live
+                and name not in keep
             ):
                 try:
                     os.unlink(os.path.join(self.root, name))
@@ -212,27 +238,54 @@ class DispatcherJournal:
 
     def record_submit(self, request_id: int, payload: Any) -> None:
         """Payload first (atomic rename), THEN the submit mark: the WAL
-        never references bytes that aren't durably there."""
-        path = self._payload_path(request_id)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.save(f, np.asarray(payload), allow_pickle=False)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        self._fsync_root()  # the rename itself must survive a host crash
-        self._append({"op": "submit", "id": request_id})
+        never references bytes that aren't durably there. The id is
+        reserved against the compaction sweep for the whole window where
+        the payload exists without its mark."""
+        with self._lock:
+            self._writing.add(request_id)
+        try:
+            path = self._payload_path(request_id)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.save(f, np.asarray(payload), allow_pickle=False)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            self._fsync_root()  # the rename must survive a host crash
+            self._append({"op": "submit", "id": request_id})
+        finally:
+            with self._lock:
+                self._writing.discard(request_id)
+
+    #: Group-commit width for payload reclaim: one fsync per this many
+    #: completions, then their payloads unlink in a batch.
+    RECLAIM_EVERY = 64
 
     def record_done(self, request_id: int) -> None:
-        # No fsync: a done mark lost to the page cache costs exactly one
-        # extra replay (the documented at-least-once window), and the
-        # mark rides the hot completion path — fsyncing it would cap
-        # throughput at disk latency for zero added guarantee.
+        # No per-mark fsync: a done mark lost to the page cache costs
+        # exactly one extra replay (the documented at-least-once
+        # window), and the mark rides the hot completion path — fsyncing
+        # each would cap throughput at disk latency for zero added
+        # guarantee. The payload is NOT unlinked inline (an unlink whose
+        # directory metadata beat the page-cached mark to disk would
+        # make a completed request look LOST on recovery); instead,
+        # every RECLAIM_EVERY completions pay ONE fsync and then unlink
+        # that whole batch — their marks are durable first.
         self._append({"op": "done", "id": request_id}, fsync=False)
-        try:  # payload no longer needed; best-effort space reclaim
-            os.unlink(self._payload_path(request_id))
-        except OSError:
-            pass
+        batch: list[int] = []
+        with self._lock:
+            self._reclaimable.append(request_id)
+            if len(self._reclaimable) >= self.RECLAIM_EVERY:
+                try:
+                    os.fsync(self._wal.fileno())
+                except (OSError, ValueError):
+                    return  # keep the batch; try again next time
+                batch, self._reclaimable = self._reclaimable, []
+        for rid in batch:
+            try:
+                os.unlink(self._payload_path(rid))
+            except OSError:
+                pass
 
     def close(self) -> None:
         with self._lock:

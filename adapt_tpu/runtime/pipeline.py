@@ -232,14 +232,28 @@ class ServingPipeline:
         gateway_host: str = "127.0.0.1",
         gateway_port: int = 0,
         gateway_secret: str | None = None,
+        journal_dir: str | None = None,
     ):
+        """``journal_dir`` (optional): write-ahead journal accepted
+        requests + the dial-out worker table there, so a crashed serving
+        process can be rebuilt with ``Dispatcher.recover`` — see
+        :mod:`adapt_tpu.control.journal`."""
         devices = list(devices if devices is not None else jax.devices())
         self.config = config or ServeConfig()
         self.registry = WorkerRegistry(
             default_ttl_s=self.config.fault.lease_ttl_s
         )
+        journal = None
+        if journal_dir is not None:
+            from adapt_tpu.control.journal import DispatcherJournal
+
+            journal = DispatcherJournal(journal_dir)
         self.dispatcher = Dispatcher(
-            plan, variables, registry=self.registry, config=self.config
+            plan,
+            variables,
+            registry=self.registry,
+            config=self.config,
+            journal=journal,
         )
         self.workers = self.dispatcher.spawn_workers(devices)
         self.gateway = None

@@ -1,0 +1,8 @@
+#!/bin/bash
+# Round-5 combined queue: run 1 (scoreboard-critical) then run 2 (traces
+# + MBU sweep). One serial stream through the relay.
+cd "$(dirname "$0")"
+bash r05_tpu_queue.sh
+rc=$?
+echo "=== queue1 exited rc=$rc; starting queue2"
+bash r05_tpu_queue2.sh

@@ -21,6 +21,15 @@ timeout 1800 python benchmarks/lm_decode_profile.py --prompt 1024 \
   --maxlen 2048 --out "$OUT/trace_decode_2k" | tail -1 \
   | tee -a "$OUT/lm_decode_profile_2k.json"
 
+log "2b. fixed-overhead separation for the MBU gap: same maxlen,"
+log "    steps 128 vs 512 — marginal per-step cost = (t512-t128)/384."
+log "    If marginal MBU >> headline MBU, the gap is per-CALL overhead"
+log "    (relay dispatch + prefill), not the decode loop itself."
+timeout 1800 python benchmarks/lm_decode.py --prompt 64 --maxlen 1024 \
+  --steps 128 | tail -1 | tee -a "$OUT/lm_decode_m1024_s128.json"
+timeout 1800 python benchmarks/lm_decode.py --prompt 64 --maxlen 1024 \
+  --steps 512 | tail -1 | tee -a "$OUT/lm_decode_m1024_s512.json"
+
 log "3. speculative decoding on-chip row"
 timeout 1800 python benchmarks/speculative_decode.py | tail -1
 

@@ -472,6 +472,54 @@ def test_chain_rejects_in_process_workers(devices):
         disp.shutdown()
 
 
+def test_chain_kill_mid_burst_exactly_once(devices):
+    """Kill the TAIL chain worker while a burst is in flight: chain
+    entries in every state (queued at head, mid-hop, awaiting tail) must
+    replay end-to-end through the hub path — exactly once, right
+    answers, no hangs. This is the riskiest chain path: whole-request
+    replay racing live traffic."""
+    from adapt_tpu.control.dispatcher import Dispatcher
+    from adapt_tpu.graph import partition
+    from adapt_tpu.models.vit import vit_block_cuts, vit_tiny
+
+    g = vit_tiny()
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = g.init(jax.random.PRNGKey(0), x)
+    cuts = vit_block_cuts(4, 3)
+    plan = partition(g, cuts)
+    y_ref = np.asarray(g.apply(variables, x))
+    cfg = _chain_cfg()
+    disp = Dispatcher(plan, variables, config=cfg)
+    # Local fallback pool so replays have somewhere to land even while
+    # remote membership churns.
+    disp.spawn_workers(devices[:3])
+    procs, proxies = _chain_pool(disp, cfg, cuts, [17641, 17642, 17643])
+    try:
+        disp.start()
+        for pr in proxies:
+            pr.start()
+        disp.setup_chain([pr.worker_id for pr in proxies])
+        disp.serve_stream([x] * 2, timeout_per_request=120.0)  # warm chain
+        futures = [disp.submit(x) for _ in range(10)]
+        proxies[2].kill("crash")  # tail dies with the burst in flight
+        outs = [f.result(180.0) for f in futures]
+        for y in outs:
+            np.testing.assert_allclose(
+                np.asarray(y), y_ref, rtol=1e-5, atol=1e-5
+            )
+        assert disp._chain is None  # the failure disabled the chain
+        # Exactly-once: every submitted future completed with a value
+        # (no double-complete is possible through PipelineFuture, and
+        # none errored).
+        assert all(f._error is None for f in futures)
+    finally:
+        disp.shutdown()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+
+
 # -- architecture-by-value ---------------------------------------------------
 
 

@@ -718,6 +718,8 @@ def test_journal_compaction_bounds_history(tmp_path):
     still-pending request."""
     from adapt_tpu.control.journal import DispatcherJournal
 
+    import os
+
     root = str(tmp_path / "j")
     j = DispatcherJournal(root, compact_every=20)
     j.record_worker("w0", "127.0.0.1", 1234, meta={"codec": "none"})
@@ -729,8 +731,16 @@ def test_journal_compaction_bounds_history(tmp_path):
     with open(root + "/wal.jsonl", encoding="utf-8") as f:
         n_lines = sum(1 for _ in f)
     assert n_lines < 30  # ~600 appends compacted away
+    # Payload reclaim (group-commit + compaction sweep) bounds disk too:
+    # the pending payload survives, done payloads don't accumulate.
+    payloads = [n for n in os.listdir(root) if n.startswith("req_")]
+    assert "req_150.npy" in payloads
+    assert len(payloads) < 100
     workers, pending, next_id = DispatcherJournal(root).load()
     assert set(workers) == {"w0"}
     assert workers["w0"]["port"] == 1234
     assert set(pending) == {150}
     assert next_id == 300
+    # A dispatcher built OVER this journal must not recycle ids 0..299
+    # (a fresh counter would clear pending id 150 with its done marks).
+    assert DispatcherJournal(root).next_request_id == 300
