@@ -30,6 +30,13 @@ timeout 1800 python benchmarks/lm_decode.py --prompt 64 --maxlen 1024 \
 timeout 1800 python benchmarks/lm_decode.py --prompt 64 --maxlen 1024 \
   --steps 512 | tail -1 | tee -a "$OUT/lm_decode_m1024_s512.json"
 
+log "2c. decode-MBU ablation: measured streaming ceiling + per-component"
+log "    cost + additivity residual (the arithmetic gap accounting)"
+timeout 1800 python benchmarks/lm_decode_ablate.py | tail -1 \
+  | tee -a "$OUT/lm_decode_ablate.json"
+timeout 1800 python benchmarks/lm_decode_ablate.py --maxlen 2048 \
+  --steps 32 | tail -1 | tee -a "$OUT/lm_decode_ablate_2k.json"
+
 log "3. speculative decoding on-chip row"
 timeout 1800 python benchmarks/speculative_decode.py | tail -1
 
