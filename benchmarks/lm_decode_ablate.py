@@ -91,28 +91,44 @@ def _child(batch: int, steps: int, max_len: int, trials: int) -> None:
             qkv = x @ b["qkv"]  # (B, 1, 3D)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(batch, 1, HEADS, hd).transpose(0, 2, 1, 3)
+            # bf16 operands + f32 accumulation via preferred_element_type:
+            # an .astype(f32) on the loop-invariant cache would be HOISTED
+            # by XLA into a materialized f32 copy, silently doubling the
+            # bytes each step streams vs what the row is charged.
             s = jnp.einsum(
-                "bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                ck.astype(jnp.float32),
+                "bhqd,bhkd->bhqk", q, ck,
+                preferred_element_type=jnp.float32,
             ) / np.sqrt(hd)
             mask = jnp.arange(max_len) <= index
             s = jnp.where(mask[None, None, None, :], s, -1e30)
             p = jax.nn.softmax(s, axis=-1).astype(jnp.bfloat16)
-            o = jnp.einsum("bhqk,bhkd->bhqd", p, cv)
+            o = jnp.einsum(
+                "bhqk,bhkd->bhqd", p, cv,
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.bfloat16)
             o = o.transpose(0, 2, 1, 3).reshape(batch, 1, DIM)
             x = x + o @ b["out"]
         return x
 
+    def _logits(x):
+        # bf16 matmul, f32 accumulate — same convert-hoisting hazard as
+        # the cache above (w_head is 77 MB; an f32 copy would be 154).
+        return jax.lax.dot_general(
+            x, w_head, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (B, 1, V) f32
+
     def step_head(x):
-        return x + (
-            (x.astype(jnp.float32) @ w_head.astype(jnp.float32))[..., :DIM]
-        ).astype(jnp.bfloat16)
+        # Consume EVERY logits column (a [..., :DIM] slice would let
+        # XLA rewrite slice(dot) into a dot over 1.5% of w_head and
+        # fake a 60x-faster head).
+        m = _logits(x).max(axis=-1, keepdims=True)  # (B, 1, 1)
+        return x * jnp.bfloat16(0.5) + m.astype(jnp.bfloat16) * 1e-9
 
     def step_full(x, index):
         x = step_attn(x, index)
         x = step_mlp(x)
-        lg = x.astype(jnp.float32) @ w_head.astype(jnp.float32)
-        tok = jnp.argmax(lg, axis=-1)  # (B, 1)
+        tok = jnp.argmax(_logits(x), axis=-1)  # (B, 1)
         # Re-embed the argmax: the real loop's token->embedding data
         # dependency, defeating cross-step pipelining XLA couldn't do
         # for the real model either.
@@ -135,50 +151,64 @@ def _child(batch: int, steps: int, max_len: int, trials: int) -> None:
     x0 = mk(batch, 1, DIM)
     v0 = mk(DIM)
 
+    # Each variant is jitted as a function of its INITIAL carry so
+    # trials can perturb the input — repeat executions of identical
+    # (fn, args) can be deduplicated under this image's remote-execution
+    # tunnel (same countermeasure as lm_decode.py's timed()).
     variants = {}
-    blk_w = [b for b in blocks]
     variants["stream"] = (
-        lambda: lax.scan(
-            lambda c, _: (step_stream(c), ()), v0, None, length=steps
+        lambda init: lax.scan(
+            lambda c, _: (step_stream(c), ()), init, None, length=steps
         )[0],
-        bytes_of((blk_w, w_head, w_embed)),
+        v0,
+        bytes_of((blocks, w_head, w_embed)),
     )
     variants["mlp"] = (
-        lambda: lax.scan(
-            lambda c, _: (step_mlp(c), ()), x0, None, length=steps
+        lambda init: lax.scan(
+            lambda c, _: (step_mlp(c), ()), init, None, length=steps
         )[0],
+        x0,
         bytes_of([(b["fc"], b["proj"]) for b in blocks]),
     )
     variants["attn"] = (
-        lambda: lax.scan(
+        lambda init: lax.scan(
             lambda c, i: (step_attn(c, i), ()),
-            x0,
+            init,
             jnp.arange(steps),
         )[0],
+        x0,
         bytes_of([(b["qkv"], b["out"]) for b in blocks])
         + bytes_of(caches),
     )
     variants["head"] = (
-        lambda: lax.scan(
-            lambda c, _: (step_head(c), ()), x0, None, length=steps
+        lambda init: lax.scan(
+            lambda c, _: (step_head(c), ()), init, None, length=steps
         )[0],
+        x0,
         bytes_of(w_head),
     )
     variants["full"] = (
-        lambda: lax.scan(
-            lambda c, i: (step_full(c, i), ()), x0, jnp.arange(steps)
+        lambda init: lax.scan(
+            lambda c, i: (step_full(c, i), ()), init, jnp.arange(steps)
         )[0],
-        bytes_of((blk_w, w_head, w_embed)) + bytes_of(caches),
+        x0,
+        # w_embed is read one GATHERED row per batch element per step,
+        # not wholesale — charging the full 77 MB table would overstate
+        # the achieved bandwidth ~20%.
+        bytes_of((blocks, w_head))
+        + bytes_of(caches)
+        + batch * DIM * 2,
     )
 
     rows = {}
-    for name, (fn, nbytes) in variants.items():
+    for name, (fn, init, nbytes) in variants.items():
         jfn = jax.jit(fn)
-        np.asarray(jfn())  # compile + warm
+        np.asarray(jfn(init))  # compile + warm
         times = []
-        for _ in range(trials):
+        for t in range(trials):
+            perturbed = init + jnp.bfloat16(1e-6 * (t + 1))
             t0 = time.perf_counter()
-            np.asarray(jfn())
+            np.asarray(jfn(perturbed))
             times.append(time.perf_counter() - t0)
         per_step = statistics.median(times) / steps
         rows[name] = {
@@ -198,8 +228,6 @@ def _child(batch: int, steps: int, max_len: int, trials: int) -> None:
         # across components actually HELPS the full program.
         "residual_ms": round(rows["full"]["ms_per_step"] - parts, 4),
     }
-    import jax as _jax
-
     print(
         json.dumps(
             {
@@ -214,7 +242,7 @@ def _child(batch: int, steps: int, max_len: int, trials: int) -> None:
                 "baseline": "the stream variant's measured achievable "
                 f"bandwidth ({rows['stream']['achieved_gb_s']} GB/s; "
                 "spec sheet 819)",
-                "platform": _jax.devices()[0].platform,
+                "platform": jax.devices()[0].platform,
                 "batch": batch,
                 "steps": steps,
                 "max_len": max_len,
