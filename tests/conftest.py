@@ -82,3 +82,53 @@ def spawn_worker_proc(*cli_args: str) -> "subprocess.Popen":
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
     )
+
+
+def chain_cfg(configure_timeout_s: float = 60.0):
+    """ServeConfig used by the chain-forwarding tests (shared by
+    test_comm and test_control)."""
+    from adapt_tpu.config import FaultConfig, ServeConfig
+
+    return ServeConfig(
+        fault=FaultConfig(
+            lease_ttl_s=2.0,
+            heartbeat_s=0.2,
+            task_deadline_s=30.0,
+            watchdog_period_s=0.2,
+            startup_wait_s=15.0,
+            configure_timeout_s=configure_timeout_s,
+        )
+    )
+
+
+def chain_pool(
+    disp, cfg, cuts, ports, codec_name: str = "none", prefix: str = "chain"
+):
+    """Spawn one worker process per port and attach dial-out proxies —
+    the shared setup for every chain-forwarding test. Returns
+    (procs, proxies)."""
+    from adapt_tpu.comm.remote import RemoteWorkerProxy
+
+    procs = [
+        spawn_worker_proc("--port", str(p), "--heartbeat", "0.2")
+        for p in ports
+    ]
+    proxies = []
+    for i, p in enumerate(ports):
+        pr = RemoteWorkerProxy(
+            f"{prefix}-{i}",
+            ("127.0.0.1", p),
+            disp.registry,
+            disp.result_queue,
+            model_config={
+                "model": "vit_tiny",
+                "num_classes": 10,
+                "cuts": cuts,
+                "input_shape": [2, 32, 32, 3],
+            },
+            codec_name=codec_name,
+            fault=cfg.fault,
+        )
+        disp.attach_worker(pr)
+        proxies.append(pr)
+    return procs, proxies

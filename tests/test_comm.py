@@ -16,7 +16,7 @@ import pytest
 from adapt_tpu.comm import codec as codec_lib
 from adapt_tpu.comm import native
 from adapt_tpu.comm.framing import MSG_DATA, Message, recv_msg, send_msg
-from conftest import spawn_worker_proc
+from conftest import chain_cfg, chain_pool, spawn_worker_proc
 
 
 # -- native codec -----------------------------------------------------------
@@ -315,49 +315,6 @@ def test_remote_probe_roundtrip_and_hang_swallow():
 # -- chain forwarding (direct worker→worker data plane) ----------------------
 
 
-def _chain_pool(disp, cfg, cuts, ports):
-    """Spawn one worker process per port and attach dial-out proxies."""
-    from adapt_tpu.comm.remote import RemoteWorkerProxy
-
-    procs = [
-        spawn_worker_proc("--port", str(p), "--heartbeat", "0.1")
-        for p in ports
-    ]
-    proxies = []
-    for i, p in enumerate(ports):
-        pr = RemoteWorkerProxy(
-            f"chain-{i}",
-            ("127.0.0.1", p),
-            disp.registry,
-            disp.result_queue,
-            model_config={
-                "model": "vit_tiny",
-                "num_classes": 10,
-                "cuts": cuts,
-                "input_shape": [2, 32, 32, 3],
-            },
-            fault=cfg.fault,
-        )
-        disp.attach_worker(pr)
-        proxies.append(pr)
-    return procs, proxies
-
-
-def _chain_cfg():
-    from adapt_tpu.config import FaultConfig, ServeConfig
-
-    return ServeConfig(
-        fault=FaultConfig(
-            lease_ttl_s=2.0,
-            heartbeat_s=0.2,
-            task_deadline_s=30.0,
-            watchdog_period_s=0.2,
-            startup_wait_s=15.0,
-            configure_timeout_s=60.0,
-        )
-    )
-
-
 def test_chain_forwarding_bypasses_hub(devices):
     """3 remote workers in chain mode: every intermediate activation hops
     worker→worker (reference Gen-1 topology, ``src/node.py:163-179``);
@@ -373,9 +330,9 @@ def test_chain_forwarding_bypasses_hub(devices):
     cuts = vit_block_cuts(4, 3)
     plan = partition(g, cuts)
     y_ref = np.asarray(g.apply(variables, x))
-    cfg = _chain_cfg()
+    cfg = chain_cfg()
     disp = Dispatcher(plan, variables, config=cfg)
-    procs, proxies = _chain_pool(disp, cfg, cuts, [17621, 17622, 17623])
+    procs, proxies = chain_pool(disp, cfg, cuts, [17621, 17622, 17623])
     try:
         disp.start()
         for pr in proxies:
@@ -416,11 +373,11 @@ def test_chain_failure_falls_back_to_hub_exactly_once(devices):
     cuts = vit_block_cuts(4, 3)
     plan = partition(g, cuts)
     y_ref = np.asarray(g.apply(variables, x))
-    cfg = _chain_cfg()
+    cfg = chain_cfg()
     disp = Dispatcher(plan, variables, config=cfg)
     # Local fallback capacity for after the kill.
     disp.spawn_workers(devices[:2])
-    procs, proxies = _chain_pool(disp, cfg, cuts, [17631, 17632, 17633])
+    procs, proxies = chain_pool(disp, cfg, cuts, [17631, 17632, 17633])
     try:
         disp.start()
         for pr in proxies:
@@ -472,6 +429,45 @@ def test_chain_rejects_in_process_workers(devices):
         disp.shutdown()
 
 
+def test_chain_forwarding_composes_with_codec(devices):
+    """Chain hops carry codec-packed activations (frames are
+    self-describing, so each hop unpacks whatever its upstream packed):
+    int8-quantized activations over a 3-hop chain must still produce
+    outputs within quantization tolerance of the full model."""
+    from adapt_tpu.control.dispatcher import Dispatcher
+    from adapt_tpu.graph import partition
+    from adapt_tpu.models.vit import vit_block_cuts, vit_tiny
+
+    g = vit_tiny()
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = g.init(jax.random.PRNGKey(0), x)
+    cuts = vit_block_cuts(4, 3)
+    plan = partition(g, cuts)
+    y_ref = np.asarray(g.apply(variables, x))
+    cfg = chain_cfg()
+    disp = Dispatcher(plan, variables, config=cfg)
+    procs, proxies = chain_pool(
+        disp, cfg, cuts, [17645, 17646, 17647],
+        codec_name="int8", prefix="cchain",
+    )
+    try:
+        disp.start()
+        for pr in proxies:
+            pr.start()
+        disp.setup_chain([pr.worker_id for pr in proxies])
+        outs = disp.serve_stream([x] * 4, timeout_per_request=120.0)
+        for y in outs:
+            assert np.max(np.abs(np.asarray(y) - y_ref)) < 0.3
+        assert proxies[0].results_received == 0
+        assert proxies[2].results_received == 4
+    finally:
+        disp.shutdown()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+
+
 def test_chain_kill_mid_burst_exactly_once(devices):
     """Kill the TAIL chain worker while a burst is in flight: chain
     entries in every state (queued at head, mid-hop, awaiting tail) must
@@ -488,12 +484,12 @@ def test_chain_kill_mid_burst_exactly_once(devices):
     cuts = vit_block_cuts(4, 3)
     plan = partition(g, cuts)
     y_ref = np.asarray(g.apply(variables, x))
-    cfg = _chain_cfg()
+    cfg = chain_cfg()
     disp = Dispatcher(plan, variables, config=cfg)
     # Local fallback pool so replays have somewhere to land even while
     # remote membership churns.
     disp.spawn_workers(devices[:3])
-    procs, proxies = _chain_pool(disp, cfg, cuts, [17641, 17642, 17643])
+    procs, proxies = chain_pool(disp, cfg, cuts, [17641, 17642, 17643])
     try:
         disp.start()
         for pr in proxies:

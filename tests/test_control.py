@@ -711,6 +711,60 @@ def test_dispatcher_crash_recovery_exactly_once(tmp_path):
             p.wait(timeout=10)
 
 
+def test_journal_composes_with_chain_forwarding(tmp_path):
+    """Journal on + chain forwarding on: chain-dispatched requests are
+    journaled like any other, and after the dispatcher completes them —
+    including any that replayed through the hub when the chain broke —
+    the journal has nothing pending."""
+    from conftest import chain_cfg, chain_pool
+
+    from adapt_tpu.control.dispatcher import Dispatcher
+    from adapt_tpu.control.journal import DispatcherJournal
+    from adapt_tpu.models.vit import vit_block_cuts, vit_tiny
+
+    g = vit_tiny()
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = g.init(jax.random.PRNGKey(0), x)
+    cuts = vit_block_cuts(4, 3)
+    plan = partition(g, cuts)
+    y_ref = np.asarray(g.apply(variables, x))
+    cfg = chain_cfg(configure_timeout_s=120.0)
+    root = str(tmp_path / "cj")
+    disp = Dispatcher(
+        plan, variables, config=cfg, journal=DispatcherJournal(root)
+    )
+    procs, proxies = chain_pool(
+        disp, cfg, cuts, [17685, 17686, 17687], prefix="jc"
+    )
+    try:
+        disp.start()
+        for pr in proxies:
+            pr.start()
+        disp.setup_chain([pr.worker_id for pr in proxies])
+        futures = [disp.submit(x) for _ in range(6)]
+        proxies[1].kill("crash")  # mid-chain death while journaled work flies
+        for f in futures:
+            np.testing.assert_allclose(
+                np.asarray(f.result(180.0)), y_ref, rtol=1e-5, atol=1e-5
+            )
+        # The break really happened: the mid worker's death (link drop ->
+        # membership leave) disabled the chain even if every request had
+        # already drained — otherwise this test silently covers only the
+        # no-failure path.
+        deadline = time.monotonic() + 10.0
+        while disp._chain is not None:
+            assert time.monotonic() < deadline, "chain never disabled"
+            time.sleep(0.05)
+    finally:
+        disp.shutdown()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+    _, pending, _ = DispatcherJournal(root).load()
+    assert pending == {}  # every journaled request reached a done mark
+
+
 def test_journal_compaction_bounds_history(tmp_path):
     """The WAL rewrites itself to live state every compact_every appends:
     size is bounded by pending work + pool size, not all-time history,
