@@ -63,13 +63,29 @@ except Exception:  # pragma: no cover — jax builds without pallas-tpu
 
 _NEG_INF = -1e30
 
-#: Cache-position block per grid step. 1024 = 8 sublanes x 128 lanes of
-#: the chunked scale view, the smallest block whose scale tile satisfies
-#: TPU (8, 128) tiling without broadcast padding — so the kernel requires
-#: max_len % 1024 == 0 (every serving config in the repo uses powers of
-#: two >= 1024 when long context is the point; shorter caches stay on
-#: XLA, which wins there anyway).
+#: Cache-position block per grid step for QUANTIZED caches. 1024 = 8
+#: sublanes x 128 lanes of the chunked scale view, the smallest block
+#: whose scale tile satisfies TPU (8, 128) tiling without broadcast
+#: padding — int8 caches therefore need max_len % 1024 == 0. NATIVE
+#: caches carry no scale tiles, so their block can shrink to 256 and the
+#: kernel serves short-context configs too (the headline max_len-256 row
+#: streams its cache at ~0.26 efficiency on the XLA einsum path — the
+#: analytic decomposition in benchmarks/README.md — which is exactly the
+#: access pattern this kernel replaces).
 DECODE_BLOCK_K = 1024
+_MIN_NATIVE_BLOCK_K = 256
+
+
+def default_block_k(cache_len: int, quantized: bool) -> int:
+    """Largest supported cache block for this (cache_len, dtype):
+    quantized caches are pinned to the scale-tile block; native caches
+    take the largest of 1024/512/256 dividing the cache."""
+    if quantized:
+        return DECODE_BLOCK_K
+    for bk in (1024, 512, _MIN_NATIVE_BLOCK_K):
+        if cache_len % bk == 0:
+            return bk
+    return DECODE_BLOCK_K  # leaves _supported() False -> XLA fallback
 
 
 def decode_kernel_wins(cache_len: int, quantized: bool) -> bool:
@@ -83,8 +99,11 @@ def decode_kernel_wins(cache_len: int, quantized: bool) -> bool:
     return False
 
 
-def _supported(cache_len: int, block_k: int) -> bool:
-    return pltpu is not None and cache_len % block_k == 0
+def _supported(cache_len: int, block_k: int, quantized: bool) -> bool:
+    if pltpu is None or cache_len % block_k:
+        return False
+    # int8 scale tiles need (block_k//128) >= 8 rows per (8, 128) tile.
+    return not quantized or block_k % DECODE_BLOCK_K == 0
 
 
 def _decode_kernel(
@@ -318,7 +337,7 @@ def decode_attention(
     index,
     valid_from=None,
     prefer: str | None = None,
-    block_k: int = DECODE_BLOCK_K,
+    block_k: int | None = None,
 ) -> jax.Array:
     """Cached decode attention over the live window ``[valid_from,
     index]`` of a KV cache.
@@ -332,11 +351,14 @@ def decode_attention(
 
     ``prefer``: None = auto (``decode_kernel_wins``, the measured rule),
     ``"xla"`` = the einsum oracle, ``"pallas"`` = the streaming kernel
-    (falls back to the oracle off-pallas or when L is not a multiple of
-    ``block_k`` — the kernel's scale-tile layout needs 1024-divisible
-    caches)."""
+    (falls back to the oracle off-pallas or when L doesn't divide into
+    supported blocks: native caches need L % 256 == 0, int8 caches
+    L % 1024 == 0 — the scale-tile layout). ``block_k`` None picks the
+    largest supported block (``default_block_k``)."""
     quantized = isinstance(cache_k, tuple)
     cache_len = (cache_k[0] if quantized else cache_k).shape[2]
+    if block_k is None:
+        block_k = default_block_k(cache_len, quantized)
     if prefer is None:
         prefer = (
             "pallas" if decode_kernel_wins(cache_len, quantized) else "xla"
@@ -345,7 +367,7 @@ def decode_attention(
         raise ValueError(
             f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
         )
-    if prefer == "pallas" and _supported(cache_len, block_k):
+    if prefer == "pallas" and _supported(cache_len, block_k, quantized):
         if quantized:
             (kvl, ksc), (vvl, vsc) = cache_k, cache_v
             return _decode_impl(

@@ -55,6 +55,11 @@ def _child() -> None:
         ("int8_gqa4_4k", 4096, 4, True, False, False),
         ("native_per_row_idx", 2048, 1, False, True, False),
         ("int8_ragged", 2048, 1, True, False, True),
+        # The newly-eligible short-native shape class (block_k 256,
+        # num_kv=1 grid) — its Mosaic lowering must prove itself here
+        # before the queued headline-config A/B spends its slot on it.
+        ("native_short_256", 256, 1, False, False, False),
+        ("native_short_512_gqa4", 512, 4, False, False, False),
     ]:
         kq, kk, kv_ = jax.random.split(jax.random.fold_in(rng, length + g), 3)
         q = jax.random.normal(kq, (b, kvh, g, hd), jnp.float32)

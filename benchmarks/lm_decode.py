@@ -122,16 +122,25 @@ def _child(
 
     kv_dtype = "int8" if kv == "int8" else "native"
     attn = None if decode_attn == "auto" else decode_attn
-    if attn == "pallas" and max_len % 1024:
-        # decode_attention silently serves the oracle when the cache
-        # length is not kernel-eligible — an A/B row labeled
-        # `_attn_pallas` that actually measured XLA would corrupt the
-        # measured dispatch rule. Refuse instead.
-        raise SystemExit(
-            f"--decode-attn pallas needs --maxlen % 1024 == 0 "
-            f"(got {max_len}): the kernel would fall back to XLA and "
-            "the artifact label would lie"
+    if attn == "pallas":
+        # Ask the op itself (ONE source of truth for eligibility — a
+        # re-encoded literal here drifted once already): decode_attention
+        # silently serves the oracle when the cache length is not
+        # kernel-eligible, and an A/B row labeled `_attn_pallas` that
+        # actually measured XLA would corrupt the measured dispatch rule.
+        from adapt_tpu.ops.decode_attention import (
+            _supported,
+            default_block_k,
         )
+
+        q8 = kv_dtype == "int8"
+        if not _supported(max_len, default_block_k(max_len, q8), q8):
+            raise SystemExit(
+                f"--decode-attn pallas: maxlen {max_len} with "
+                f"kv={kv_dtype} is not kernel-eligible (native needs "
+                "%256==0, int8 %1024==0): the kernel would fall back "
+                "to XLA and the artifact label would lie"
+            )
     cached_s = timed(
         lambda p: generate(
             lm, variables, p, steps, kv_cache_dtype=kv_dtype,

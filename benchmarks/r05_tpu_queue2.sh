@@ -21,6 +21,11 @@ timeout 1800 python benchmarks/lm_decode_profile.py --prompt 1024 \
   --maxlen 2048 --out "$OUT/trace_decode_2k" | tail -1 \
   | tee -a "$OUT/lm_decode_profile_2k.json"
 
+log "2a. SHORT-context kernel A/B (native 256-cache newly eligible:"
+log "    block_k 256) — the headline MBU-0.43 row through the kernel"
+timeout 1800 python benchmarks/lm_decode.py --decode-attn pallas \
+  | tail -1 | tee -a "$OUT/lm_decode_pallas.json"
+
 log "2b. fixed-overhead separation for the MBU gap: same maxlen,"
 log "    steps 128 vs 512 — marginal per-step cost = (t512-t128)/384."
 log "    If marginal MBU >> headline MBU, the gap is per-CALL overhead"
