@@ -27,6 +27,28 @@ boundary. Offered codecs:
              boundaries"). Host-side codecs above shrink only the wire;
              this one shrinks the PCIe/DMA hop too.
 
+Zero-copy framing contract (the serving hot path):
+
+- ``encode_view(x) -> (parts, meta)`` returns the payload as a list of
+  buffer views with NO framing copy: ``raw`` hands out a memoryview of
+  the (contiguous) array itself; the transforming codecs hand out views
+  of the single array their transform materialized. ``encode`` remains
+  the bytes-returning compat wrapper.
+- ``pack_frames`` returns ``[length+header, *payload_views]`` for
+  scatter writes (``socket.sendmsg``) — zero payload copies on the send
+  path. ``pack`` assembles the same frames into ONE pre-sized buffer
+  (exactly one payload copy, down from two in the old
+  encode-then-concat scheme); ``pack_into`` reuses a caller-pooled
+  ``bytearray``.
+- ``unpack`` slices with memoryviews, so ``decode`` sees a view of the
+  receive buffer and ``raw`` decode returns an array that SHARES memory
+  with it (``np.frombuffer``) — no receive-side copy either.
+
+Framing-layer payload copies are counted in module counters
+(:func:`copy_stats` / :func:`reset_copy_stats`) so tests and
+``benchmarks/micro/codec_framing.py`` can assert the ≤1-copy budget
+instead of trusting the docstring.
+
 All codecs are symmetric: ``decode(*encode(x))`` returns an array of the
 original shape/dtype (within the codec's stated tolerance).
 """
@@ -34,6 +56,7 @@ original shape/dtype (within the codec's stated tolerance).
 from __future__ import annotations
 
 import json
+import threading
 from dataclasses import dataclass
 from typing import Protocol
 
@@ -41,42 +64,123 @@ import numpy as np
 
 from adapt_tpu.comm import native
 
+# -- framing-copy accounting -------------------------------------------------
+
+#: Bytes/calls of PAYLOAD memcpy performed by the framing layer (frame
+#: assembly and bytes-compat joins). Codec transforms (cast/quantize/
+#: compress) are not copies — they produce the payload; what these count
+#: is every time already-encoded payload bytes are moved again.
+_COPY_BYTES = 0
+_COPY_CALLS = 0
+#: pack/unpack run concurrently (one hop thread per LocalPipeline stage,
+#: one sender thread per remote proxy) — unsynchronized += would lose
+#: increments exactly when the pipeline is actually pipelining.
+_COPY_LOCK = threading.Lock()
+
+
+def _count_copy(nbytes: int) -> None:
+    global _COPY_BYTES, _COPY_CALLS
+    with _COPY_LOCK:
+        _COPY_BYTES += int(nbytes)
+        _COPY_CALLS += 1
+
+
+def copy_stats() -> dict:
+    """Framing-layer payload-copy counters since the last reset."""
+    with _COPY_LOCK:
+        return {"bytes": _COPY_BYTES, "calls": _COPY_CALLS}
+
+
+def reset_copy_stats() -> None:
+    global _COPY_BYTES, _COPY_CALLS
+    with _COPY_LOCK:
+        _COPY_BYTES = 0
+        _COPY_CALLS = 0
+
+
+def _byte_view(buf) -> memoryview:
+    """Flat uint8 view of any buffer-protocol object (no copy)."""
+    mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+    if mv.format != "B" or mv.ndim != 1:
+        mv = mv.cast("B")
+    return mv
+
+
+def _array_view(a: np.ndarray) -> memoryview:
+    """Byte view of a contiguous ndarray's buffer — no copy. Extension
+    dtypes without buffer support (ml_dtypes bfloat16) reinterpret as
+    uint8 first (a view, still no copy)."""
+    try:
+        return _byte_view(a.data)
+    except (ValueError, TypeError):
+        return _byte_view(a.view(np.uint8).data)
+
+
+def _parts_nbytes(parts) -> int:
+    return sum(_byte_view(p).nbytes for p in parts)
+
+
+def _join_parts(parts) -> bytes:
+    """bytes-compat assembly of encode_view parts (counted as a copy)."""
+    views = [_byte_view(p) for p in parts]
+    _count_copy(sum(v.nbytes for v in views))
+    if len(views) == 1:
+        return views[0].tobytes()
+    return b"".join(views)
+
 
 class Codec(Protocol):
     name: str
 
     def encode(self, x: np.ndarray) -> tuple[bytes, dict]: ...
 
-    def decode(self, blob: bytes, meta: dict) -> np.ndarray: ...
+    def encode_view(self, x) -> tuple[list, dict]: ...
+
+    def decode(self, blob, meta: dict) -> np.ndarray: ...
 
 
 def _meta(x: np.ndarray, **extra) -> dict:
     return {"shape": list(x.shape), "dtype": str(x.dtype), **extra}
 
 
+class _ViewEncodeMixin:
+    """``encode`` as the compat wrapper over the zero-copy ``encode_view``."""
+
+    def encode(self, x) -> tuple[bytes, dict]:
+        parts, meta = self.encode_view(x)
+        return _join_parts(parts), meta
+
+
 @dataclass(frozen=True)
-class RawCodec:
+class RawCodec(_ViewEncodeMixin):
     name: str = "none"
 
-    def encode(self, x: np.ndarray) -> tuple[bytes, dict]:
+    def encode_view(self, x) -> tuple[list, dict]:
+        # Contiguous input: the "payload" IS the array's buffer — zero
+        # copies (ascontiguousarray is the identity there).
         x = np.ascontiguousarray(x)
-        return x.tobytes(), _meta(x)
+        return [_array_view(x)], _meta(x)
 
-    def decode(self, blob: bytes, meta: dict) -> np.ndarray:
+    def decode(self, blob, meta: dict) -> np.ndarray:
+        # frombuffer VIEWS blob: with a memoryview of the receive buffer
+        # this is the zero-copy receive path (read-only array when the
+        # buffer is immutable bytes — serving never mutates activations
+        # in place).
         return np.frombuffer(blob, dtype=meta["dtype"]).reshape(meta["shape"])
 
 
 @dataclass(frozen=True)
-class Bf16Codec:
+class Bf16Codec(_ViewEncodeMixin):
     name: str = "bf16"
 
-    def encode(self, x: np.ndarray) -> tuple[bytes, dict]:
+    def encode_view(self, x) -> tuple[list, dict]:
         import ml_dtypes
 
-        y = np.ascontiguousarray(x).astype(ml_dtypes.bfloat16)
-        return y.tobytes(), _meta(x)
+        x = np.ascontiguousarray(x)
+        y = x.astype(ml_dtypes.bfloat16)  # the transform, not a copy
+        return [_array_view(y)], _meta(x)
 
-    def decode(self, blob: bytes, meta: dict) -> np.ndarray:
+    def decode(self, blob, meta: dict) -> np.ndarray:
         import ml_dtypes
 
         y = np.frombuffer(blob, dtype=ml_dtypes.bfloat16)
@@ -84,22 +188,22 @@ class Bf16Codec:
 
 
 @dataclass(frozen=True)
-class Int8Codec:
+class Int8Codec(_ViewEncodeMixin):
     name: str = "int8"
 
-    def encode(self, x: np.ndarray) -> tuple[bytes, dict]:
+    def encode_view(self, x) -> tuple[list, dict]:
         x = np.ascontiguousarray(x)
         scale = float(np.max(np.abs(x))) / 127.0 or 1.0
         q = np.clip(np.round(x / scale), -127, 127).astype(np.int8)
-        return q.tobytes(), _meta(x, scale=scale)
+        return [_array_view(q)], _meta(x, scale=scale)
 
-    def decode(self, blob: bytes, meta: dict) -> np.ndarray:
+    def decode(self, blob, meta: dict) -> np.ndarray:
         q = np.frombuffer(blob, dtype=np.int8).reshape(meta["shape"])
         return (q.astype(np.float32) * meta["scale"]).astype(meta["dtype"])
 
 
 @dataclass(frozen=True)
-class ZfpLikeCodec:
+class ZfpLikeCodec(_ViewEncodeMixin):
     """Fixed-tolerance int16 quantization + native LZ compression — the
     accuracy-mode zfp analog (reference default is reversible mode; our
     tolerance defaults are conservative)."""
@@ -107,41 +211,45 @@ class ZfpLikeCodec:
     tolerance: float = 1e-3
     name: str = "zfp"
 
-    def encode(self, x: np.ndarray) -> tuple[bytes, dict]:
+    def encode_view(self, x) -> tuple[list, dict]:
         x = np.ascontiguousarray(x, dtype=np.float32)
         # Quantization step sized so |err| <= tolerance/2; clamp the range
         # so int16 suffices (meta carries the actual scale).
         step = max(self.tolerance, float(np.max(np.abs(x))) / 32767.0, 1e-12)
         q = np.clip(np.round(x / step), -32767, 32767).astype(np.int16)
-        raw = q.tobytes()
-        comp = native.compress(raw)
-        return comp, _meta(x, step=step, raw_len=len(raw))
+        raw_len = q.nbytes
+        # The compressor reads the quantized array's buffer directly —
+        # no tobytes staging copy.
+        comp = native.compress(_array_view(q))
+        return [comp], _meta(x, step=step, raw_len=raw_len)
 
-    def decode(self, blob: bytes, meta: dict) -> np.ndarray:
+    def decode(self, blob, meta: dict) -> np.ndarray:
         raw = native.decompress(blob, meta["raw_len"])
         q = np.frombuffer(raw, dtype=np.int16).reshape(meta["shape"])
         return (q.astype(np.float32) * meta["step"]).astype(meta["dtype"])
 
 
 @dataclass(frozen=True)
-class LzCodec:
+class LzCodec(_ViewEncodeMixin):
     """Lossless: raw bytes through the native LZ77 compressor. Dtype- and
     bit-exact, so safe for weights and integer tensors."""
 
     name: str = "lz"
 
-    def encode(self, x: np.ndarray) -> tuple[bytes, dict]:
+    def encode_view(self, x) -> tuple[list, dict]:
         x = np.ascontiguousarray(x)
-        raw = x.tobytes()
-        return native.compress(raw), _meta(x, raw_len=len(raw))
+        raw_len = x.nbytes
+        return [native.compress(_array_view(x))], _meta(
+            x, raw_len=raw_len
+        )
 
-    def decode(self, blob: bytes, meta: dict) -> np.ndarray:
+    def decode(self, blob, meta: dict) -> np.ndarray:
         raw = native.decompress(blob, meta["raw_len"])
         return np.frombuffer(raw, dtype=meta["dtype"]).reshape(meta["shape"])
 
 
 @dataclass(frozen=True)
-class DeviceInt8Codec:
+class DeviceInt8Codec(_ViewEncodeMixin):
     """Blockwise int8 quantization executed *on device* (Pallas kernel,
     ``ops/quantize.py``): the tensor leaves the chip already 4x smaller.
     Encode accepts a jax.Array (host ndarrays are device_put first);
@@ -149,27 +257,30 @@ class DeviceInt8Codec:
 
     name: str = "int8dev"
 
-    def encode(self, x) -> tuple[bytes, dict]:
+    def encode_view(self, x) -> tuple[list, dict]:
         import jax.numpy as jnp
 
         from adapt_tpu.ops.quantize import quantize
 
         arr = x if hasattr(x, "devices") else jnp.asarray(x)
         qt = quantize(arr)
-        vals = np.asarray(qt.values)  # the 4x-smaller host fetch
-        scales = np.asarray(qt.scales)
-        return vals.tobytes() + scales.tobytes(), {
+        vals = np.ascontiguousarray(qt.values)  # the 4x-smaller host fetch
+        scales = np.ascontiguousarray(qt.scales)
+        # Two natural payload parts (scatter write sends both without the
+        # old vals+scales concat).
+        return [_array_view(vals), _array_view(scales)], {
             "shape": list(qt.shape),
             "dtype": str(np.dtype(qt.dtype)),
             "rows": list(vals.shape),
             "nblocks": int(scales.shape[0]),
         }
 
-    def decode(self, blob: bytes, meta: dict) -> np.ndarray:
+    def decode(self, blob, meta: dict) -> np.ndarray:
         import jax.numpy as jnp
 
         from adapt_tpu.ops.quantize import QuantizedTensor, dequantize
 
+        blob = _byte_view(blob)
         rows = tuple(meta["rows"])
         nvals = rows[0] * rows[1]
         vals = np.frombuffer(blob[:nvals], dtype=np.int8).reshape(rows)
@@ -206,15 +317,76 @@ def get_codec(name: str, tolerance: float | None = None) -> Codec:
         ) from None
 
 
-def pack(codec: Codec, x: np.ndarray) -> bytes:
-    """codec name + meta + payload in one self-describing buffer."""
+def _encode_parts(codec: Codec, x) -> tuple[list, dict]:
+    """encode_view when the codec offers it; bytes-compat fallback for
+    third-party codecs that only implement ``encode``."""
+    view = getattr(codec, "encode_view", None)
+    if view is not None:
+        return view(x)
     blob, meta = codec.encode(x)
+    return [blob], meta
+
+
+def pack_frames(codec: Codec, x) -> list:
+    """The frame as scatter-write parts: ``[4-byte header length + JSON
+    header, *payload buffer views]``. ZERO payload copies — hand the
+    list to ``framing.send_msg`` (``socket.sendmsg``) or assemble it
+    with :func:`pack`. The payload views may alias ``x``; send (or copy)
+    before mutating it."""
+    parts, meta = _encode_parts(codec, x)
     header = json.dumps({"codec": codec.name, **meta}).encode()
-    return len(header).to_bytes(4, "big") + header + blob
+    return [len(header).to_bytes(4, "big") + header, *parts]
 
 
-def unpack(buf: bytes, tolerance: float | None = None) -> np.ndarray:
-    hlen = int.from_bytes(buf[:4], "big")
-    meta = json.loads(buf[4 : 4 + hlen].decode())
+def frames_nbytes(frames) -> int:
+    """Total wire size of a :func:`pack_frames` result."""
+    return _parts_nbytes(frames)
+
+
+def pack_into(codec: Codec, x, buf: bytearray) -> memoryview:
+    """Assemble the frame into caller-pooled ``buf`` (grown in place,
+    never shrunk) and return a view of the written region — exactly ONE
+    payload copy and zero allocations once the pool is warm. The view
+    aliases ``buf``: consume it before the next ``pack_into`` on the
+    same pool."""
+    frames = pack_frames(codec, x)
+    total = _parts_nbytes(frames)
+    if len(buf) < total:
+        buf.extend(bytes(total - len(buf)))
+    out = memoryview(buf)
+    off = 0
+    for part in frames:
+        v = _byte_view(part)
+        out[off : off + v.nbytes] = v
+        off += v.nbytes
+    _count_copy(total - len(frames[0]))  # header writes aren't payload
+    return out[:total]
+
+
+def pack(codec: Codec, x) -> bytearray:
+    """codec name + meta + payload in one self-describing buffer.
+
+    One payload copy (frame assembly into a pre-sized buffer), down
+    from two in the old encode-``tobytes``-then-concat scheme; use
+    :func:`pack_frames` for the zero-copy scatter-write path."""
+    frames = pack_frames(codec, x)
+    buf = bytearray(_parts_nbytes(frames))
+    out = memoryview(buf)
+    off = 0
+    for part in frames:
+        v = _byte_view(part)
+        out[off : off + v.nbytes] = v
+        off += v.nbytes
+    _count_copy(len(buf) - len(frames[0]))
+    return buf
+
+
+def unpack(buf, tolerance: float | None = None) -> np.ndarray:
+    """Decode a :func:`pack` frame. Slices with memoryviews, so the codec
+    sees a VIEW of ``buf`` and ``raw`` decode returns an array sharing
+    memory with the receive buffer (zero-copy receive)."""
+    mv = _byte_view(buf)
+    hlen = int.from_bytes(mv[:4], "big")
+    meta = json.loads(bytes(mv[4 : 4 + hlen]).decode())
     codec = get_codec(meta.pop("codec"), tolerance)
-    return codec.decode(buf[4 + hlen :], meta)
+    return codec.decode(mv[4 + hlen :], meta)

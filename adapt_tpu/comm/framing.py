@@ -4,11 +4,24 @@ Capability parity with the reference's hand-rolled protocol
 (``/root/reference/src/node_state.py:39-161``): length-prefixed framing
 (there: 8-byte big-endian length + chunked non-blocking sends with a
 ``select`` spin; here: the same 8-byte BE length prefix over blocking
-sockets with ``sendall`` — the chunk/spin loop is an artifact of
-non-blocking sockets the design doesn't need) and a fixed routing header
-(there: a 4-byte partition index, ``src/dispatcher.py:209-213``; here: a
-typed header carrying message type, stage index, request id and attempt so
-re-dispatch and exactly-once work across hosts too).
+sockets — the chunk/spin loop is an artifact of non-blocking sockets the
+design doesn't need) and a fixed routing header (there: a 4-byte
+partition index, ``src/dispatcher.py:209-213``; here: a typed header
+carrying message type, stage index, request id and attempt so re-dispatch
+and exactly-once work across hosts too).
+
+Zero-copy hot path (the codec-framing design, ``comm/codec.py``):
+
+- **Send** is a scatter write: ``Message.payload`` may be bytes, any
+  buffer view, or a LIST of buffer parts (``codec.pack_frames``), and
+  :func:`send_msg` hands ``[prefix+header, *parts]`` to
+  ``socket.sendmsg`` — the kernel gathers, so the payload is never
+  concatenated host-side.
+- **Receive** lands each frame in ONE pre-sized ``bytearray`` via
+  ``recv_into`` (no chunk-list join) and ``Message.payload`` is a
+  memoryview of it — ``codec.unpack`` then returns arrays viewing that
+  same buffer. Use :func:`payload_bytes` where real ``bytes`` are
+  needed (JSON control payloads).
 """
 
 from __future__ import annotations
@@ -16,6 +29,7 @@ from __future__ import annotations
 import socket
 import struct
 from dataclasses import dataclass
+from typing import Any
 
 #: msg types (reference: implied by port number — 6000 data / 6001 config /
 #: 6003 results; here: explicit enum in-band on one port).
@@ -35,29 +49,85 @@ _LEN = struct.Struct(">Q")
 ACK_BYTE = b"\x06"
 
 
+def _byte_view(part) -> memoryview:
+    mv = part if isinstance(part, memoryview) else memoryview(part)
+    if mv.format != "B" or mv.ndim != 1:
+        mv = mv.cast("B")
+    return mv
+
+
+def _payload_parts(payload) -> list[memoryview]:
+    """Normalize a payload (bytes | buffer | list of either) to flat
+    byte views for the scatter send."""
+    if isinstance(payload, (list, tuple)):
+        views = [_byte_view(p) for p in payload]
+        return [v for v in views if v.nbytes]
+    mv = _byte_view(payload)
+    return [mv] if mv.nbytes else []
+
+
+def payload_bytes(payload) -> bytes:
+    """Materialize a received (or multi-part) payload as bytes — for
+    small control payloads (JSON, error strings), not the data path."""
+    if isinstance(payload, bytes):
+        return payload
+    if isinstance(payload, (list, tuple)):
+        return b"".join(bytes(_byte_view(p)) for p in payload)
+    return bytes(_byte_view(payload))
+
+
 @dataclass(frozen=True)
 class Message:
     msg_type: int
     stage_index: int
     request_id: int
     attempt: int
-    payload: bytes
+    #: bytes on receive-construct paths; any buffer view or a list of
+    #: buffer parts (``codec.pack_frames``) on the send path.
+    payload: Any
+
+
+def _sendmsg_all(sock: socket.socket, parts: list[memoryview]) -> None:
+    """sendall semantics over ``socket.sendmsg``: loop until every part
+    is on the wire, advancing views across partial sends (sendmsg, like
+    send, may write any prefix of the gather list)."""
+    while parts:
+        try:
+            sent = sock.sendmsg(parts)
+        except (AttributeError, OSError) as e:
+            # No sendmsg on this socket object (test doubles) — fall
+            # back to sendall per part. OSError other than missing
+            # support propagates.
+            if not isinstance(e, AttributeError):
+                raise
+            for p in parts:
+                sock.sendall(p)
+            return
+        while parts and sent >= parts[0].nbytes:
+            sent -= parts[0].nbytes
+            parts.pop(0)
+        if sent:
+            parts[0] = parts[0][sent:]
 
 
 def send_msg(sock: socket.socket, msg: Message) -> None:
-    header = _HEADER.pack(
+    parts = _payload_parts(msg.payload)
+    total = _HEADER.size + sum(p.nbytes for p in parts)
+    header = _LEN.pack(total) + _HEADER.pack(
         msg.msg_type, msg.stage_index, msg.request_id, msg.attempt
     )
-    sock.sendall(_LEN.pack(len(header) + len(msg.payload)) + header + msg.payload)
+    # One gather write: prefix+header and every payload part go to the
+    # kernel as-is — zero host-side concatenation of the payload.
+    _sendmsg_all(sock, [memoryview(header), *parts])
 
 
-def _recv_exact(
-    sock: socket.socket, n: int, retry_on_timeout: bool = True
-) -> bytes:
-    chunks = []
-    while n:
+def _recv_exact_into(
+    sock: socket.socket, buf: memoryview, retry_on_timeout: bool = True
+) -> None:
+    n, off = buf.nbytes, 0
+    while off < n:
         try:
-            chunk = sock.recv(min(n, 1 << 20))
+            got = sock.recv_into(buf[off:], min(n - off, 1 << 20))
         except TimeoutError:
             if retry_on_timeout:
                 # A socket timeout usually exists to bound *sends* (a
@@ -67,28 +137,29 @@ def _recv_exact(
                 # mid-frame would desync the stream.
                 continue
             raise
-        if not chunk:
+        if not got:
             raise ConnectionError("peer closed mid-frame")
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+        off += got
 
 
 def recv_msg(sock: socket.socket, retry_on_timeout: bool = True) -> Message:
     """``retry_on_timeout=False`` turns the socket's timeout into a hard
     receive deadline (used where a silent peer must not hold a serial
-    loop — e.g. the gateway's HELLO handshake)."""
-    (total,) = _LEN.unpack(_recv_exact(sock, _LEN.size, retry_on_timeout))
+    loop — e.g. the gateway's HELLO handshake). The returned payload is
+    a memoryview of the frame's single receive buffer (zero-copy:
+    ``codec.unpack`` arrays share its memory)."""
+    lenbuf = bytearray(_LEN.size)
+    _recv_exact_into(sock, memoryview(lenbuf), retry_on_timeout)
+    (total,) = _LEN.unpack(lenbuf)
     if total < _HEADER.size:
         raise ConnectionError(f"short frame: {total}")
-    buf = _recv_exact(sock, total, retry_on_timeout)
-    msg_type, stage_index, request_id, attempt = _HEADER.unpack(
-        buf[: _HEADER.size]
-    )
+    buf = bytearray(total)
+    _recv_exact_into(sock, memoryview(buf), retry_on_timeout)
+    msg_type, stage_index, request_id, attempt = _HEADER.unpack_from(buf)
     return Message(
         msg_type=msg_type,
         stage_index=stage_index,
         request_id=request_id,
         attempt=attempt,
-        payload=buf[_HEADER.size :],
+        payload=memoryview(buf)[_HEADER.size :],
     )

@@ -83,22 +83,42 @@ def load() -> ctypes.CDLL | None:
         return _lib
 
 
-def compress(data: bytes) -> bytes:
+def _c_src(buf):
+    """ctypes-passable view of any bytes-like object WITHOUT copying when
+    possible: bytes pass through (c_char_p accepts them) and writable
+    buffers (ndarray.data, bytearray) wrap via from_buffer; only
+    read-only non-bytes views pay a materializing copy."""
+    if isinstance(buf, bytes):
+        return buf, len(buf)
+    mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+    if mv.format != "B" or mv.ndim != 1:
+        mv = mv.cast("B")
+    if mv.readonly:
+        b = bytes(mv)
+        return b, len(b)
+    return (ctypes.c_char * mv.nbytes).from_buffer(mv), mv.nbytes
+
+
+def compress(data) -> bytes:
+    """LZ-compress any bytes-like object (bytes, bytearray, memoryview,
+    ndarray buffer) — buffer inputs avoid a staging ``tobytes`` copy."""
     lib = load()
     if lib is None:
         import zlib
 
         return b"Z" + zlib.compress(data, 1)
-    bound = lib.qz_bound(len(data))
+    src, n_src = _c_src(data)
+    bound = lib.qz_bound(n_src)
     dst = ctypes.create_string_buffer(bound)
-    n = lib.qz_compress(data, len(data), dst, bound)
+    n = lib.qz_compress(src, n_src, dst, bound)
     if n == 0:
         raise RuntimeError("qz_compress failed")
     return b"Q" + dst.raw[:n]
 
 
-def decompress(blob: bytes, raw_len: int) -> bytes:
-    tag, body = blob[:1], blob[1:]
+def decompress(blob, raw_len: int) -> bytes:
+    mv = blob if isinstance(blob, memoryview) else memoryview(blob)
+    tag, body = bytes(mv[:1]), mv[1:]
     if tag == b"Z":
         import zlib
 
@@ -110,8 +130,9 @@ def decompress(blob: bytes, raw_len: int) -> bytes:
     lib = load()
     if lib is None:
         raise RuntimeError("native qcodec unavailable for 'Q' blob")
+    src, n_src = _c_src(body)
     dst = ctypes.create_string_buffer(raw_len)
-    n = lib.qz_decompress(body, len(body), dst, raw_len)
+    n = lib.qz_decompress(src, n_src, dst, raw_len)
     if n == 0:
         raise ValueError("qz_decompress: malformed input")
     return dst.raw[:n]

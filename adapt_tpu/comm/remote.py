@@ -42,6 +42,7 @@ from adapt_tpu.comm.framing import (
     MSG_ERROR,
     MSG_RESULT,
     Message,
+    payload_bytes,
     recv_msg,
     send_msg,
 )
@@ -340,7 +341,7 @@ class RemoteStageServer:
                     # chained results/errors route hub-ward even when the
                     # triggering data frame came from a peer worker.
                     self._primary_reply = reply
-                    cfg = json.loads(msg.payload.decode())
+                    cfg = json.loads(payload_bytes(msg.payload).decode())
                     pending[(msg.stage_index, msg.request_id)] = {
                         "cfg": cfg,
                         "arrays": {},
@@ -349,7 +350,7 @@ class RemoteStageServer:
                 elif msg.msg_type == MSG_SET_ROUTE:
                     self._primary_reply = reply
                     try:
-                        info = json.loads(msg.payload.decode())
+                        info = json.loads(payload_bytes(msg.payload).decode())
                         if info.get("clear"):
                             self._routes.pop(msg.stage_index, None)
                             self._fwd_gc()
@@ -462,7 +463,7 @@ class RemoteStageServer:
                         )
                     )
                 elif msg.msg_type == MSG_KILL:
-                    mode = msg.payload.decode()
+                    mode = payload_bytes(msg.payload).decode()
                     log.warning("remote worker kill: %s", mode)
                     if mode == "hang":
                         self._hung = True
@@ -503,7 +504,10 @@ class RemoteStageServer:
             y.block_until_ready()
             # Device array handed to the codec directly: int8dev quantizes
             # on-chip before the host fetch; host codecs coerce themselves.
-            out = codec_lib.pack(self._codec, y)
+            # pack_frames + the framing layer's scatter write: the encoded
+            # payload goes to the kernel as buffer views, never
+            # concatenated host-side (zero framing copies per hop).
+            out = codec_lib.pack_frames(self._codec, y)
             if route is None:
                 # Hub routing: the stage output returns whence it came.
                 reply(
@@ -711,6 +715,10 @@ class RemoteWorkerProxy:
         self._config_acks: dict[tuple[int, int], threading.Event] = {}
         self._config_errors: dict[tuple[int, int], str] = {}
         self._inflight_count = 0
+        #: (stage, request, attempt) submits this proxy counted into
+        #: _inflight_count — the only results allowed to decrement it
+        #: (chain-tail results for head-submitted requests are not).
+        self._counted: set[tuple[int, int, int]] = set()
         self._count_lock = threading.Lock()
         self._stop = threading.Event()
         self._reader: threading.Thread | None = None
@@ -1027,8 +1035,10 @@ class RemoteWorkerProxy:
             return
         # Pass the payload through un-coerced: device-side codecs
         # (int8dev) quantize on-chip BEFORE the host fetch; host codecs
-        # call np.ascontiguousarray themselves.
-        payload = codec_lib.pack(self._codec, task.payload)
+        # call np.ascontiguousarray themselves. pack_frames: the encoded
+        # payload rides as buffer views into the framing layer's scatter
+        # write — no host-side header+payload concatenation.
+        payload = codec_lib.pack_frames(self._codec, task.payload)
         if getattr(task, "chained", False):
             # Chain-mode head submit: the RESULT arrives on the TAIL
             # worker's link, so counting it here would leak this proxy's
@@ -1044,8 +1054,10 @@ class RemoteWorkerProxy:
                 )
             )
             return
+        key = (task.stage_index, task.request_id, task.attempt)
         with self._count_lock:
             self._inflight_count += 1
+            self._counted.add(key)
         try:
             self._send(
                 Message(
@@ -1058,7 +1070,9 @@ class RemoteWorkerProxy:
             )
         except Exception:
             with self._count_lock:
-                self._inflight_count = max(0, self._inflight_count - 1)
+                if key in self._counted:
+                    self._counted.discard(key)
+                    self._inflight_count = max(0, self._inflight_count - 1)
             raise
 
     def kill(self, mode: str = "crash") -> None:
@@ -1095,15 +1109,28 @@ class RemoteWorkerProxy:
             elif msg.msg_type == MSG_CONFIG_ERR:
                 key = (msg.stage_index, msg.request_id)
                 with self._ack_lock:
-                    self._config_errors[key] = msg.payload.decode()
+                    self._config_errors[key] = payload_bytes(
+                        msg.payload
+                    ).decode()
                     ev = self._config_acks.get(key)
                 if ev is not None:
                     ev.set()
             elif msg.msg_type in (MSG_RESULT, MSG_ERROR):
                 self.results_received += 1
                 self.result_bytes_received += len(msg.payload)
+                # Only a result matching a submit THIS proxy counted may
+                # decrement: a chain tail delivers results for requests
+                # the HEAD proxy submitted (never counted here), and
+                # blindly decrementing would deflate this link's
+                # in-flight depth and skew least-loaded _acquire ranking
+                # toward the tail worker (ADVICE r5).
+                key = (msg.stage_index, msg.request_id, msg.attempt)
                 with self._count_lock:
-                    self._inflight_count = max(0, self._inflight_count - 1)
+                    if key in self._counted:
+                        self._counted.discard(key)
+                        self._inflight_count = max(
+                            0, self._inflight_count - 1
+                        )
                 if msg.msg_type == MSG_RESULT:
                     self._results.put(
                         TaskResult(
@@ -1121,7 +1148,7 @@ class RemoteWorkerProxy:
                             stage_index=msg.stage_index,
                             attempt=msg.attempt,
                             worker_id=self.worker_id,
-                            error=msg.payload.decode(),
+                            error=payload_bytes(msg.payload).decode(),
                         )
                     )
         # Socket gone: mark the link dead so the scheduler stops picking
@@ -1239,7 +1266,7 @@ class WorkerGateway:
                     raise ValueError(
                         f"expected HELLO, got msg type {msg.msg_type}"
                     )
-                info = json.loads(msg.payload.decode())
+                info = json.loads(payload_bytes(msg.payload).decode())
                 worker_id = info["worker_id"]
                 if self._secret is not None and not hmac.compare_digest(
                     str(info.get("secret", "")), self._secret

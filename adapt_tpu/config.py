@@ -74,6 +74,40 @@ class CodecConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """SPMD pipeline schedule knobs (``parallel.pipeline_spmd``).
+
+    The serial (GPipe) schedule puts every ICI activation hop on the
+    critical path; the overlap schedule issues each hop alongside the
+    next microbatch's compute so hop latency hides under it (docs/
+    SERVING.md "Overlap-scheduled SPMD pipeline"). Consumed by
+    ``spmd_pipeline_from_config`` and ``benchmarks/micro/hop_overlap``.
+    """
+
+    # "serial" (GPipe; hop on the critical path) or "overlap"
+    # (double-buffered; hop issued concurrently with compute).
+    schedule: str = "overlap"
+    # Microbatches per global batch (more microbatches -> smaller
+    # pipeline-fill bubble, smaller per-hop payloads).
+    microbatches: int = 8
+    # Circular activation-buffer depth for the overlap schedule: a hop
+    # gets hop_buffers - 1 ticks to land. 2 = classic double buffering;
+    # raise it only when hop latency exceeds one tick's compute.
+    hop_buffers: int = 2
+
+    def __post_init__(self):
+        if self.schedule not in ("serial", "overlap"):
+            raise ValueError(
+                f"schedule={self.schedule!r}: expected 'serial' or "
+                f"'overlap'"
+            )
+        if self.microbatches < 1:
+            raise ValueError("microbatches must be >= 1")
+        if self.hop_buffers < 2:
+            raise ValueError("hop_buffers must be >= 2")
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Top-level serving configuration."""
 
@@ -82,3 +116,6 @@ class ServeConfig:
     max_inflight: int = 8
     fault: FaultConfig = dataclasses.field(default_factory=FaultConfig)
     codec: CodecConfig = dataclasses.field(default_factory=CodecConfig)
+    pipeline: PipelineConfig = dataclasses.field(
+        default_factory=PipelineConfig
+    )

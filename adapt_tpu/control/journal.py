@@ -72,6 +72,12 @@ class DispatcherJournal:
         self._appends = 0
         self._replay_file_into_mirror()
         self._wal = open(self._wal_path, "a", encoding="utf-8")
+        # Persist the WAL's DIRECTORY entry now: appends fsync the file,
+        # but a freshly created wal.jsonl only becomes durable once its
+        # directory entry reaches disk — until the first compaction's
+        # rename-fsync, a host crash could revert the creation and drop
+        # every pre-compaction append with it (ADVICE r5).
+        self._fsync_root()
 
     # -- write side ----------------------------------------------------------
 

@@ -53,8 +53,23 @@ ACT_REGISTRY: dict[str, Any] = {
 
 #: Only these package roots may be imported while rebuilding a spec: the
 #: spec names classes, and an unrestricted dotted-path import would let a
-#: spec execute arbitrary module-level code.
+#: spec execute arbitrary module-level code. Matching is dot-terminated
+#: or exact (``_under_allowed_roots``): ``flax.linen.attention.X`` and
+#: ``flax.linen.X`` qualify, a sibling package named ``flax.linenx``
+#: does not (ADVICE r5 — a bare prefix check would admit it).
 ALLOWED_MODULE_ROOTS = ("adapt_tpu.", "flax.linen")
+
+
+def _under_allowed_roots(path: str) -> bool:
+    """True when ``path`` (a dotted module.Class path) is exactly an
+    allowed root or lives under one at a ``.`` boundary."""
+    for root in ALLOWED_MODULE_ROOTS:
+        if root.endswith("."):
+            if path.startswith(root):
+                return True
+        elif path == root or path.startswith(root + "."):
+            return True
+    return False
 
 #: flax dataclass plumbing fields that are NOT hyperparameters.
 _FLAX_INTERNAL_FIELDS = frozenset({"parent", "name"})
@@ -137,7 +152,7 @@ def _module_to_spec(module: Any) -> dict:
         # (user scripts, __main__) and nested classes (the import path
         # 'pkg.Outer.Inner' does not name a module attribute reachable
         # from import_module('pkg.Outer')).
-        if not path.startswith(ALLOWED_MODULE_ROOTS):
+        if not _under_allowed_roots(path):
             raise TypeError(
                 f"cannot ship {path!r} by value: module classes must "
                 f"live under {ALLOWED_MODULE_ROOTS} on the worker image"
@@ -170,7 +185,7 @@ def _module_from_spec(spec: dict) -> Any:
     if kind != "flax":
         raise ValueError(f"unknown module kind {kind!r} in graph spec")
     path = spec["type"]
-    if not path.startswith(ALLOWED_MODULE_ROOTS):
+    if not _under_allowed_roots(path):
         raise ValueError(
             f"refusing to import {path!r}: graph specs may only name "
             f"classes under {ALLOWED_MODULE_ROOTS}"

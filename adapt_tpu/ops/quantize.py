@@ -123,7 +123,13 @@ def quantize(x: jax.Array) -> QuantizedTensor:
 
 @jax.jit
 def dequantize(qt: QuantizedTensor) -> jax.Array:
-    """Inverse of :func:`quantize` (Pallas kernel)."""
+    """Inverse of :func:`quantize` (Pallas kernel).
+
+    Deliberately NOT donated: the int8 values can never alias the f32
+    output (dtype width mismatch), so donation here would be a
+    per-compile XLA warning and nothing else — the decode path's real
+    donation lives where buffers CAN alias (``ContinuousBatcher``'s
+    caches and device-resident slot state)."""
     num_blocks = qt.scales.shape[0]
     out = pl.pallas_call(
         _dequant_kernel,

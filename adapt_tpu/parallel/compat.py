@@ -1,0 +1,47 @@
+"""shard_map across jax generations — ONE shim for every SPMD module.
+
+The repo targets the current API (``jax.shard_map`` with ``check_vma``,
+``lax.pcast(..., to="varying")``); older jax (< 0.6) ships
+``jax.experimental.shard_map`` with ``check_rep`` and no ``pcast``.
+Every shard_map-based module (``ring_attention``, ``ulysses``,
+``pipeline_spmd``, ``pipeline_decode``) routes through this shim so the
+version probe lives in exactly one place.
+
+Replication/vma checking stays OFF in both generations: the stage bodies
+may contain a ``pallas_call`` (flash kernels), whose ``out_shape``
+carries no mesh-varying annotation — the check would reject correct
+programs.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` (new API) or ``jax.experimental.shard_map``
+    (old), with vma/rep checking disabled (module docstring). Usable as
+    ``functools.partial(shard_map, mesh=..., in_specs=..., out_specs=...)``
+    decorator, mirroring the new API's shape."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
+def to_varying(tree, axes):
+    """``lax.pcast(tree, axes, to="varying")`` where the running jax has
+    it; the old shard_map (``check_rep=False``) needs no
+    replicated->varying cast, so this is the identity there."""
+    pcast = getattr(lax, "pcast", None)
+    if pcast is None:
+        return tree
+    return pcast(tree, axes, to="varying")

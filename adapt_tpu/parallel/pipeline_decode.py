@@ -62,6 +62,7 @@ from adapt_tpu.models.transformer_lm import (
     sample_next_tokens,
     validate_generate_args,
 )
+from adapt_tpu.parallel.compat import shard_map
 
 
 @dataclasses.dataclass(frozen=True)
@@ -290,7 +291,7 @@ def _pipelined_impl(
     rows2 = P(None, dp_axis) if dp_axis else rep
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(
             param_specs,
@@ -305,9 +306,6 @@ def _pipelined_impl(
             rep,  # eos_id
         ),
         out_specs=rows3,
-        # pallas_call outputs (the prefill flash dispatch) carry no vma
-        # annotation — same reason as ulysses/ring flash.
-        check_vma=False,
     )
     def run(
         params_loc,

@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from adapt_tpu.parallel.compat import shard_map, to_varying
+
 _NEG_INF = -1e30
 
 
@@ -191,7 +193,7 @@ def ring_attention(
     spec = P(None, None, axis, None)
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -228,14 +230,13 @@ def ring_attention(
             return (m, l, o, k_nxt, v_nxt), None
 
         init = (
-            *lax.pcast(
+            *to_varying(
                 (
                     jnp.full((b, h, sq, 1), _NEG_INF, q_l.dtype),
                     jnp.zeros((b, h, sq, 1), q_l.dtype),
                     jnp.zeros((b, h, sq, d), q_l.dtype),
                 ),
                 (axis,),
-                to="varying",
             ),
             k_l,
             v_l,
@@ -280,13 +281,10 @@ def _ring_attention_flash(
     spec = P(None, None, axis, None)
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        # check_vma=False: pallas_call outputs carry no mesh-varying
-        # annotation (same reason as ulysses_attention).
-        check_vma=False,
     )
     def ringed(q_l, k_l, v_l):
         rank = lax.axis_index(axis)

@@ -30,6 +30,8 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from adapt_tpu.parallel.compat import shard_map
+
 
 def ulysses_attention(
     q: jax.Array,
@@ -63,14 +65,10 @@ def ulysses_attention(
     spec = P(None, None, axis, None)
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        # check_vma=False so arbitrary attn_fn bodies compose — a
-        # pallas_call (ops.flash_attention) cannot annotate its out_shape
-        # with mesh-varying info.
-        check_vma=False,
     )
     def swapped(q_l, k_l, v_l):
         # [B, H, S/P, D] -> [B, H/P, S, D]: every rank trades sequence

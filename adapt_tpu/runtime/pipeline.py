@@ -29,7 +29,10 @@ from adapt_tpu.control.dispatcher import Dispatcher
 from adapt_tpu.control.registry import WorkerRegistry
 from adapt_tpu.core.stage import CompiledStage, compile_stages
 from adapt_tpu.graph.partition import PartitionPlan
+from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
+
+log = get_logger("pipeline")
 
 _SENTINEL = object()
 
@@ -120,7 +123,14 @@ class LocalPipeline:
         """Throughput path: a thread per stage connected by depth-bounded
         queues; all stages run concurrently on their devices (XLA dispatch
         is async, so device i computes request r while device i+1 computes
-        r-1 — true pipelining)."""
+        r-1 — true pipelining).
+
+        Dispatch never host-syncs per hop: compute is async XLA dispatch,
+        and when a ``hop_transform`` is configured (the codec round-trip —
+        the one blocking host fetch on this path) it runs on a dedicated
+        per-stage hop thread, so stage i computes request r+1 while its
+        hop for request r is still fetching/encoding — the MPMD analog of
+        the SPMD overlap schedule (``parallel.pipeline_spmd``)."""
         n_stages = len(self.stages)
         qs: list[queue.Queue] = [queue.Queue(maxsize=4) for _ in range(n_stages + 1)]
         outputs: list[jax.Array] = []
@@ -143,17 +153,42 @@ class LocalPipeline:
                     continue
             return _SENTINEL
 
+        # With a hop transform, each stage is TWO loops bridged by its
+        # own depth-bounded queue: the compute loop dispatches the jit
+        # (async) and hands the un-synced device array to the hop loop,
+        # which pays the blocking host round-trip. Without one, compute
+        # feeds the next stage directly (device-to-device, no host sync
+        # anywhere).
+        hop_qs: list[queue.Queue | None] = [
+            queue.Queue(maxsize=2) if self.hop_transform is not None else None
+            for _ in range(n_stages)
+        ]
+
         def stage_loop(i: int):
             stage = self.stages[i]
+            out_q = hop_qs[i] or qs[i + 1]
             while True:
                 item = get_or_abort(qs[i])
                 if item is _SENTINEL or isinstance(item, _StageError):
-                    put_or_abort(qs[i + 1], item)
+                    put_or_abort(out_q, item)
                     break
                 try:
                     y = stage(item)
-                    if self.hop_transform is not None:
-                        y = self.hop_transform(y, stage.spec.index)
+                except Exception as e:  # noqa: BLE001 — surface to caller
+                    put_or_abort(out_q, _StageError(stage.spec.index, e))
+                    break
+                if not put_or_abort(out_q, y):
+                    break
+
+        def hop_loop(i: int):
+            stage = self.stages[i]
+            while True:
+                y = get_or_abort(hop_qs[i])
+                if y is _SENTINEL or isinstance(y, _StageError):
+                    put_or_abort(qs[i + 1], y)
+                    break
+                try:
+                    y = self.hop_transform(y, stage.spec.index)
                 except Exception as e:  # noqa: BLE001 — surface to caller
                     put_or_abort(qs[i + 1], _StageError(stage.spec.index, e))
                     break
@@ -163,6 +198,10 @@ class LocalPipeline:
         threads = [
             threading.Thread(target=stage_loop, args=(i,), daemon=True)
             for i in range(n_stages)
+        ] + [
+            threading.Thread(target=hop_loop, args=(i,), daemon=True)
+            for i in range(n_stages)
+            if hop_qs[i] is not None
         ]
         for t in threads:
             t.start()
@@ -256,6 +295,7 @@ class ServingPipeline:
             journal=journal,
         )
         self.workers = self.dispatcher.spawn_workers(devices)
+        self._journal_dir = journal_dir
         self.gateway = None
         if gateway_model_config is not None:
             from adapt_tpu.comm.remote import WorkerGateway
@@ -277,6 +317,28 @@ class ServingPipeline:
         return self.gateway.port
 
     def start(self) -> "ServingPipeline":
+        if self._journal_dir is not None:
+            # Only dial-out remote workers are journaled (in-process
+            # workers die with this process; gateway joiners redial on
+            # their own — see Dispatcher.attach_worker). A journal over a
+            # purely in-process pool can replay REQUESTS but will never
+            # re-adopt a worker, so Dispatcher.recover would find an
+            # empty pool. Checked at start — not in __init__, where
+            # spawn_workers has only built in-process workers and the
+            # attach_worker(RemoteWorkerProxy) calls that make the
+            # journal useful haven't happened yet.
+            with self.dispatcher._workers_lock:
+                pool = list(self.dispatcher._workers.values())
+            if not any(
+                getattr(w, "chain_address", None) is not None for w in pool
+            ):
+                log.warning(
+                    "journal_dir=%r configured but the worker pool holds "
+                    "no journaled (dial-out remote) workers: after a "
+                    "crash, recovery cannot re-adopt any worker from "
+                    "this journal",
+                    self._journal_dir,
+                )
         self.dispatcher.start()
         if self.gateway is not None:
             self.gateway.start()
