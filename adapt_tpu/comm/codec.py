@@ -63,6 +63,7 @@ from typing import Protocol
 import numpy as np
 
 from adapt_tpu.comm import native
+from adapt_tpu.utils.metrics import global_metrics
 
 # -- framing-copy accounting -------------------------------------------------
 
@@ -96,6 +97,18 @@ def reset_copy_stats() -> None:
     with _COPY_LOCK:
         _COPY_BYTES = 0
         _COPY_CALLS = 0
+
+
+def _copy_stats_collector(registry) -> None:
+    """Pull the module counters into the registry at scrape time —
+    ``/metrics`` shows ``codec.copy_bytes``/``codec.copy_calls`` without
+    a registry write on every pack/unpack."""
+    s = copy_stats()
+    registry.set_gauge("codec.copy_bytes", float(s["bytes"]))
+    registry.set_gauge("codec.copy_calls", float(s["calls"]))
+
+
+global_metrics().register_collector(_copy_stats_collector)
 
 
 def _byte_view(buf) -> memoryview:

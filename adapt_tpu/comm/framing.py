@@ -7,8 +7,8 @@ Capability parity with the reference's hand-rolled protocol
 sockets — the chunk/spin loop is an artifact of non-blocking sockets the
 design doesn't need) and a fixed routing header (there: a 4-byte
 partition index, ``src/dispatcher.py:209-213``; here: a typed header
-carrying message type, stage index, request id and attempt so re-dispatch
-and exactly-once work across hosts too).
+carrying message type, stage index, request id, attempt and a FLAGS
+byte so re-dispatch and exactly-once work across hosts too).
 
 Zero-copy hot path (the codec-framing design, ``comm/codec.py``):
 
@@ -22,6 +22,13 @@ Zero-copy hot path (the codec-framing design, ``comm/codec.py``):
   memoryview of it — ``codec.unpack`` then returns arrays viewing that
   same buffer. Use :func:`payload_bytes` where real ``bytes`` are
   needed (JSON control payloads).
+
+Observability annex (``FLAG_TRACE_ANNEX``): a message may carry a small
+out-of-band blob — serialized tracer spans the remote worker recorded
+for this request (``utils.tracing.export_spans``) — without disturbing
+the payload's zero-copy contract: the annex rides length-prefixed
+BEFORE the payload, so the payload remains one contiguous view of the
+receive buffer. Cost when unused: one flags byte per frame.
 """
 
 from __future__ import annotations
@@ -41,9 +48,15 @@ MSG_ERROR = 5
 
 #: header: type, stage_index (signed: canary probes use PING_STAGE = -1),
 #: request_id (signed: probe ids are negative, disjoint from requests),
-#: attempt.
-_HEADER = struct.Struct(">BiqI")
+#: attempt, flags.
+_HEADER = struct.Struct(">BiqIB")
 _LEN = struct.Struct(">Q")
+_ANNEX_LEN = struct.Struct(">I")
+
+#: Flags-byte bits. TRACE_ANNEX: a u32-length-prefixed span blob
+#: precedes the payload (stitched back into the dispatcher's trace by
+#: ``comm.remote.RemoteWorkerProxy``).
+FLAG_TRACE_ANNEX = 0x01
 
 #: The reference's ACK byte (src/dispatcher.py:250-260, src/node.py:52,88).
 ACK_BYTE = b"\x06"
@@ -85,6 +98,10 @@ class Message:
     #: bytes on receive-construct paths; any buffer view or a list of
     #: buffer parts (``codec.pack_frames``) on the send path.
     payload: Any
+    #: Optional out-of-band blob (serialized trace spans). The wire
+    #: flags byte is DERIVED from its presence — senders just set
+    #: ``annex``; receivers see ``bytes`` or None.
+    annex: bytes | None = None
 
 
 def _sendmsg_all(sock: socket.socket, parts: list[memoryview]) -> None:
@@ -112,12 +129,18 @@ def _sendmsg_all(sock: socket.socket, parts: list[memoryview]) -> None:
 
 def send_msg(sock: socket.socket, msg: Message) -> None:
     parts = _payload_parts(msg.payload)
-    total = _HEADER.size + sum(p.nbytes for p in parts)
+    flags = 0
+    head_extra = b""
+    if msg.annex is not None:
+        flags |= FLAG_TRACE_ANNEX
+        head_extra = _ANNEX_LEN.pack(len(msg.annex)) + msg.annex
+    total = _HEADER.size + len(head_extra) + sum(p.nbytes for p in parts)
     header = _LEN.pack(total) + _HEADER.pack(
-        msg.msg_type, msg.stage_index, msg.request_id, msg.attempt
-    )
-    # One gather write: prefix+header and every payload part go to the
-    # kernel as-is — zero host-side concatenation of the payload.
+        msg.msg_type, msg.stage_index, msg.request_id, msg.attempt, flags
+    ) + head_extra
+    # One gather write: prefix+header (+ annex) and every payload part
+    # go to the kernel as-is — zero host-side concatenation of the
+    # payload.
     _sendmsg_all(sock, [memoryview(header), *parts])
 
 
@@ -155,11 +178,25 @@ def recv_msg(sock: socket.socket, retry_on_timeout: bool = True) -> Message:
         raise ConnectionError(f"short frame: {total}")
     buf = bytearray(total)
     _recv_exact_into(sock, memoryview(buf), retry_on_timeout)
-    msg_type, stage_index, request_id, attempt = _HEADER.unpack_from(buf)
+    msg_type, stage_index, request_id, attempt, flags = _HEADER.unpack_from(
+        buf
+    )
+    off = _HEADER.size
+    annex: bytes | None = None
+    if flags & FLAG_TRACE_ANNEX:
+        if total < off + _ANNEX_LEN.size:
+            raise ConnectionError(f"short annexed frame: {total}")
+        (alen,) = _ANNEX_LEN.unpack_from(buf, off)
+        off += _ANNEX_LEN.size
+        if total < off + alen:
+            raise ConnectionError(f"annex overruns frame: {alen}")
+        annex = bytes(buf[off : off + alen])
+        off += alen
     return Message(
         msg_type=msg_type,
         stage_index=stage_index,
         request_id=request_id,
         attempt=attempt,
-        payload=memoryview(buf)[_HEADER.size :],
+        payload=memoryview(buf)[off:],
+        annex=annex,
     )

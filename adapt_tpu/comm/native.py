@@ -16,6 +16,8 @@ import subprocess
 import threading
 
 from adapt_tpu.utils.logging import get_logger
+from adapt_tpu.utils.metrics import global_metrics
+from adapt_tpu.utils.tracing import global_flight_recorder
 
 log = get_logger("native")
 
@@ -57,11 +59,23 @@ def load() -> ctypes.CDLL | None:
         _tried = True
         if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
             if not _build():
+                # Fallback visibility: a zlib-serving pool looks healthy
+                # but pays different codec CPU — surface the downgrade on
+                # /metrics and in the flight recorder, not just a log
+                # line at import time.
+                global_metrics().inc("native.qcodec_fallback")
+                global_flight_recorder().record(
+                    "native_codec", built=False, fallback="zlib"
+                )
                 return None
         try:
             lib = ctypes.CDLL(str(_SO))
         except OSError as e:
             log.warning("qcodec load failed: %s", e)
+            global_metrics().inc("native.qcodec_fallback")
+            global_flight_recorder().record(
+                "native_codec", built=True, loaded=False, fallback="zlib"
+            )
             return None
         lib.qz_bound.restype = ctypes.c_size_t
         lib.qz_bound.argtypes = [ctypes.c_size_t]
@@ -80,6 +94,7 @@ def load() -> ctypes.CDLL | None:
             ctypes.c_size_t,
         ]
         _lib = lib
+        global_metrics().inc("native.qcodec_loaded")
         return _lib
 
 

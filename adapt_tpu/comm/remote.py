@@ -51,6 +51,7 @@ from adapt_tpu.control.registry import WorkerRegistry
 from adapt_tpu.control.worker import TaskResult, WorkerState
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
+from adapt_tpu.utils.tracing import export_spans, global_tracer
 
 log = get_logger("remote")
 
@@ -499,15 +500,44 @@ class RemoteStageServer:
             if entry is None:
                 raise RuntimeError(f"stage {msg.stage_index} not configured")
             fn, variables = entry
-            x = codec_lib.unpack(msg.payload)
-            y = fn(variables, jax.device_put(x, self.device))
-            y.block_until_ready()
-            # Device array handed to the codec directly: int8dev quantizes
-            # on-chip before the host fetch; host codecs coerce themselves.
-            # pack_frames + the framing layer's scatter write: the encoded
-            # payload goes to the kernel as buffer views, never
-            # concatenated host-side (zero framing copies per hop).
-            out = codec_lib.pack_frames(self._codec, y)
+            # Span tagged with the header's OWN request/attempt ids — the
+            # key the dispatcher stitches this back into the originating
+            # request's trace with (no side-channel correlation).
+            with global_tracer().span(
+                "remote.stage_exec",
+                request=msg.request_id,
+                attempt=msg.attempt,
+                stage=msg.stage_index,
+            ) as sp:
+                x = codec_lib.unpack(msg.payload)
+                y = fn(variables, jax.device_put(x, self.device))
+                y.block_until_ready()
+                # Device array handed to the codec directly: int8dev
+                # quantizes on-chip before the host fetch; host codecs
+                # coerce themselves. pack_frames + the framing layer's
+                # scatter write: the encoded payload goes to the kernel as
+                # buffer views, never concatenated host-side (zero framing
+                # copies per hop).
+                out = codec_lib.pack_frames(self._codec, y)
+            # Trace annex: this hop's span, appended to any spans already
+            # riding the inbound frame (mid-chain hops accumulate, so the
+            # tail result delivers the WHOLE chain's spans hub-ward).
+            annex = None
+            if sp is not None or msg.annex:
+                # A corrupt inbound annex must NEVER fail the stage (the
+                # compute already succeeded): any parse surprise just
+                # drops the upstream spans. Chains are at most num_stages
+                # hops, so the re-parse per hop stays trivial.
+                acc = []
+                if msg.annex:
+                    try:
+                        parsed = json.loads(msg.annex.decode())
+                        if isinstance(parsed, list):
+                            acc = parsed
+                    except (ValueError, UnicodeDecodeError):
+                        pass
+                acc.extend(export_spans([sp]))
+                annex = json.dumps(acc).encode()
             if route is None:
                 # Hub routing: the stage output returns whence it came.
                 reply(
@@ -517,6 +547,7 @@ class RemoteStageServer:
                         msg.request_id,
                         msg.attempt,
                         out,
+                        annex=annex,
                     )
                 )
             elif route["next"] is None:
@@ -529,6 +560,7 @@ class RemoteStageServer:
                         msg.request_id,
                         msg.attempt,
                         out,
+                        annex=annex,
                     )
                 )
             else:
@@ -546,6 +578,7 @@ class RemoteStageServer:
                                 msg.request_id,
                                 msg.attempt,
                                 out,
+                                annex=annex,
                             ),
                         )
                 except (TimeoutError, OSError):
@@ -1118,6 +1151,22 @@ class RemoteWorkerProxy:
             elif msg.msg_type in (MSG_RESULT, MSG_ERROR):
                 self.results_received += 1
                 self.result_bytes_received += len(msg.payload)
+                if msg.annex:
+                    # Remote-recorded spans for this request: stitch them
+                    # into the local trace ring (they keep the worker's
+                    # pid/tid, so /trace.json shows them on their own
+                    # process row, correlated by args.request).
+                    tracer = global_tracer()
+                    if tracer.enabled:
+                        # ingest() is garbage-tolerant (non-list JSON,
+                        # malformed entries); only the decode itself can
+                        # raise here. NOTHING may escape — an exception
+                        # would kill the read loop without _mark_dead
+                        # and silently strand every future result.
+                        try:
+                            tracer.ingest(json.loads(msg.annex.decode()))
+                        except (ValueError, UnicodeDecodeError):
+                            global_metrics().inc("tracer.ingest_rejected")
                 # Only a result matching a submit THIS proxy counted may
                 # decrement: a chain tail delivers results for requests
                 # the HEAD proxy submitted (never counted here), and

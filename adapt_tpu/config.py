@@ -108,6 +108,44 @@ class PipelineConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObservabilityConfig:
+    """Tracing + flight-recorder knobs (``utils.tracing``, served by
+    ``utils.exporter``). The flight recorder is ALWAYS on (bounded ring,
+    per-lifecycle writes only); tracing is opt-in because span recording
+    is per-stage-execution. Applying a ``ServeConfig`` (constructing a
+    ``Dispatcher``) pushes these onto the process-global tracer/recorder
+    — enable-only for ``trace_enabled``, and capacities apply only when
+    they differ from the defaults here (a default-config dispatcher must
+    never truncate a ring another component explicitly sized). A
+    standalone worker process enables tracing with ``ADAPT_TPU_TRACE=1``
+    instead."""
+
+    # Record serving-path spans into the global Tracer ring (and ship
+    # remote workers' spans back on result frames for stitching). One
+    # branch per span site when False.
+    trace_enabled: bool = False
+    # Span ring size. The ring OVERWRITES oldest spans when full
+    # (evictions counted as `tracer.spans_dropped`); size it to cover
+    # the window you expect to snapshot via GET /trace.json.
+    trace_capacity: int = 65536
+    # Flight-recorder ring size: the last N control-plane events
+    # (admissions, re-dispatches, quarantines, probe misses,
+    # recoveries) retained for GET /debug/events and post-mortem
+    # snapshots.
+    flight_capacity: int = 2048
+    # Dispatcher.recover writes a flight-recorder snapshot JSON beside
+    # the journal (flight-<unix_ts>.json) so the fault timeline that led
+    # to the crash survives the process.
+    snapshot_on_recovery: bool = True
+
+    def __post_init__(self):
+        if self.trace_capacity < 1:
+            raise ValueError("trace_capacity must be >= 1")
+        if self.flight_capacity < 1:
+            raise ValueError("flight_capacity must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Top-level serving configuration."""
 
@@ -118,4 +156,7 @@ class ServeConfig:
     codec: CodecConfig = dataclasses.field(default_factory=CodecConfig)
     pipeline: PipelineConfig = dataclasses.field(
         default_factory=PipelineConfig
+    )
+    obs: ObservabilityConfig = dataclasses.field(
+        default_factory=ObservabilityConfig
     )

@@ -21,6 +21,7 @@ worker cannot duplicate or drop a request (the reference could do both).
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import random
 import threading
@@ -31,7 +32,7 @@ from typing import Any
 
 import jax
 
-from adapt_tpu.config import ServeConfig
+from adapt_tpu.config import ObservabilityConfig, ServeConfig
 from adapt_tpu.control.registry import WorkerRegistry
 from adapt_tpu.control.worker import (
     PING_STAGE,
@@ -43,6 +44,7 @@ from adapt_tpu.control.worker import (
 from adapt_tpu.graph.partition import PartitionPlan
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
+from adapt_tpu.utils.tracing import global_flight_recorder, global_tracer
 
 log = get_logger("dispatcher")
 
@@ -128,6 +130,20 @@ class Dispatcher:
         self.plan = plan
         self.config = config or ServeConfig()
         self._journal = journal
+        # Push the observability knobs onto the process-global tracer /
+        # flight recorder. Both are apply-only-when-opinionated: tracing
+        # switched on by env (ADAPT_TPU_TRACE) or another component
+        # stays on, and a DEFAULT capacity never clobbers a ring another
+        # component explicitly sized (a second default-config dispatcher
+        # in-process must not truncate the first one's history).
+        obs = self.config.obs
+        if obs.trace_enabled:
+            global_tracer().enabled = True
+        _obs_defaults = ObservabilityConfig()
+        if obs.trace_capacity != _obs_defaults.trace_capacity:
+            global_tracer().set_capacity(obs.trace_capacity)
+        if obs.flight_capacity != _obs_defaults.flight_capacity:
+            global_flight_recorder().set_capacity(obs.flight_capacity)
         self.registry = registry or WorkerRegistry(
             default_ttl_s=self.config.fault.lease_ttl_s
         )
@@ -418,6 +434,24 @@ class Dispatcher:
             len(futures),
         )
         global_metrics().inc("dispatcher.recovered", 1)
+        recorder = global_flight_recorder()
+        recorder.record(
+            "recovery", workers=len(alive), replayed=len(futures)
+        )
+        if disp.config.obs.snapshot_on_recovery:
+            # Post-mortem artifact: the fault timeline that preceded the
+            # crash/recovery, dumped beside the journal so it outlives
+            # the ring (and the process).
+            try:
+                path = os.path.join(
+                    journal.root, f"flight-{int(time.time())}.json"
+                )
+                recorder.snapshot_to(path)
+                log.info("flight-recorder snapshot: %s", path)
+            except Exception as e:  # noqa: BLE001 — best-effort: a
+                # failed post-mortem dump must not abort a recovery
+                # whose dispatcher and replayed futures are already live.
+                log.warning("flight-recorder snapshot failed: %s", e)
         return disp, futures
 
     def shutdown(self) -> None:
@@ -659,6 +693,7 @@ class Dispatcher:
             "chain forwarding disabled (%s); hub routing resumes", reason
         )
         global_metrics().inc("dispatcher.chain_disabled")
+        global_flight_recorder().record("chain_disabled", reason=reason)
         with self._workers_lock:
             pool = dict(self._workers)
 
@@ -915,6 +950,13 @@ class Dispatcher:
         if entry.retries + 1 > self.config.fault.max_retries:
             with self._inflight_lock:
                 self._inflight.pop(entry.request_id, None)
+            global_flight_recorder().record(
+                "request_failed",
+                request=entry.request_id,
+                stage=entry.stage_index,
+                retries=entry.retries,
+                reason=reason,
+            )
             self._finish(
                 entry.future,
                 error=(
@@ -924,6 +966,14 @@ class Dispatcher:
             )
             return
         global_metrics().inc("dispatcher.redispatched")
+        global_flight_recorder().record(
+            "redispatch",
+            request=entry.request_id,
+            stage=entry.stage_index,
+            attempt=entry.attempt + 1,
+            worker=entry.worker_id,
+            reason=reason,
+        )
         log.warning(
             "re-dispatching request %d stage %d (%s), attempt %d",
             entry.request_id,
@@ -964,10 +1014,18 @@ class Dispatcher:
             global_metrics().inc(
                 "dispatcher.completed" if error is None else "dispatcher.failed"
             )
+            latency = time.monotonic() - future.submit_time
             if error is None:
-                global_metrics().observe(
-                    "request.latency_s",
-                    time.monotonic() - future.submit_time,
+                global_metrics().observe("request.latency_s", latency)
+            tracer = global_tracer()
+            if tracer.enabled:
+                end = tracer.now()
+                tracer.add_span(
+                    "request",
+                    start=end - latency,
+                    end=end,
+                    request=future.request_id,
+                    ok=error is None,
                 )
 
     # -- loops --------------------------------------------------------------
@@ -1084,6 +1142,22 @@ class Dispatcher:
             global_metrics().observe(
                 f"stage{result.stage_index}.latency_s", stage_latency
             )
+            tracer = global_tracer()
+            if tracer.enabled:
+                # Dispatch -> result round-trip, tagged with the SAME
+                # request/attempt the framing header carried — remote
+                # workers' annex-ingested spans nest under this one in
+                # the stitched trace.
+                end = tracer.now()
+                tracer.add_span(
+                    "dispatch.stage_rtt",
+                    start=end - stage_latency,
+                    end=end,
+                    request=result.request_id,
+                    attempt=result.attempt,
+                    stage=result.stage_index,
+                    worker=result.worker_id,
+                )
 
     def _add_strike_locked(
         self, worker_id: str, from_probe: bool = False
@@ -1109,6 +1183,9 @@ class Dispatcher:
         certainly doomed too — re-dispatch them now instead of one
         deadline at a time."""
         global_metrics().inc("dispatcher.quarantined")
+        global_flight_recorder().record(
+            "quarantine", worker=worker_id, why=why
+        )
         log.warning("worker %s quarantined (%s)", worker_id, why)
         with self._inflight_lock:
             doomed = [
@@ -1153,6 +1230,7 @@ class Dispatcher:
                     quarantine_now.append(wid)
         for wid in missed:
             global_metrics().inc("dispatcher.probes_missed")
+            global_flight_recorder().record("probe_miss", worker=wid)
         for wid in quarantine_now:
             self._quarantine_drain(wid, "probe missed")
         alive = set(self.registry.alive())
@@ -1252,6 +1330,7 @@ class Dispatcher:
             return
         if event != "leave":
             return
+        global_flight_recorder().record("worker_leave", worker=worker_id)
         # A departed worker's record dies with it; a future re-join under
         # the same id starts with a clean slate.
         with self._health_lock:

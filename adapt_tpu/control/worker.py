@@ -23,6 +23,7 @@ import enum
 import itertools
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -32,7 +33,7 @@ from adapt_tpu.config import FaultConfig
 from adapt_tpu.control.registry import WorkerRegistry
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
-from adapt_tpu.utils.tracing import global_tracer
+from adapt_tpu.utils.tracing import global_flight_recorder, global_tracer
 
 log = get_logger("worker")
 
@@ -65,6 +66,9 @@ class Task:
     #: returns on a DIFFERENT worker's link, so the receiving proxy must
     #: not count it against its own in-flight depth.
     chained: bool = False
+    #: Stamped by StageWorker.submit (perf-counter clock): how long the
+    #: task sat in the inbox feeds the ``worker.queue_wait_s`` histogram.
+    t_enqueue: float = 0.0
 
 
 @dataclass
@@ -143,6 +147,9 @@ class StageWorker:
     # -- fault injection ----------------------------------------------------
 
     def kill(self, mode: str = "crash") -> None:
+        global_flight_recorder().record(
+            "worker_killed", worker=self.worker_id, mode=mode
+        )
         if mode == "crash":
             self._crashed.set()
             self._inbox.put(None)
@@ -224,6 +231,7 @@ class StageWorker:
             del self._bindings[stage_index]
 
     def submit(self, task: Task) -> None:
+        task.t_enqueue = time.perf_counter()
         self._inbox.put(task)
 
     @property
@@ -274,6 +282,9 @@ class StageWorker:
                 # that.
                 self._registry.deregister(self.worker_id)
                 global_metrics().inc("worker.crash_evicted")
+                global_flight_recorder().record(
+                    "worker_crash_evicted", worker=self.worker_id
+                )
                 log.warning(
                     "worker %s evicted on crash (event, not TTL)",
                     self.worker_id,
@@ -301,6 +312,13 @@ class StageWorker:
                     )
                 )
                 continue
+            if task.t_enqueue:
+                # Inbox wait: workers drain serially, so queue depth is
+                # latency — the per-worker serving-SLO signal.
+                global_metrics().observe(
+                    "worker.queue_wait_s",
+                    time.perf_counter() - task.t_enqueue,
+                )
             with self._state_lock:
                 self._state = WorkerState.BUSY
             try:
@@ -316,6 +334,7 @@ class StageWorker:
                     stage=task.stage_index,
                     worker=self.worker_id,
                     request=task.request_id,
+                    attempt=task.attempt,
                 ):
                     x = jax.device_put(task.payload, self.device)
                     y = binding.fn(binding.variables, x)

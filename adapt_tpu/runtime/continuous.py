@@ -92,6 +92,17 @@ goes through :meth:`_h2d`, so ``stats()["h2d_transfers"]`` measures the
 host overhead directly (``benchmarks/micro/tick_host_overhead.py``
 asserts the steady-state tick stays at zero).
 
+**Request timelines** (``docs/OBSERVABILITY.md``): every request's
+lifecycle (submitted -> admitted -> prefill -> first token -> each
+decode commit -> finished/cancelled) is stamped on the perf-counter
+clock and fed to the process registry as the serving SLO histograms —
+``continuous.queue_wait_s``, ``continuous.ttft_s``,
+``continuous.itl_s`` (inter-token latency, flushed once per tick) and
+``continuous.request_latency_s``. One branch (``obs_timeline``)
+disables the histograms; flight-recorder lifecycle events
+(admit/finish/cancel — per-request, not per-token) are always on, and
+spans (prefill, decode chunk) additionally require the global tracer.
+
 Request lifecycle niceties: ``submit(stop=[[...], ...])`` ends a stream
 at the first emitted occurrence of any stop token-sequence (host-side
 tail check — the emitted prefix still equals solo ``generate()``), and
@@ -108,6 +119,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
+import time
 from functools import partial
 from collections.abc import Callable
 from typing import Any
@@ -125,6 +137,7 @@ from adapt_tpu.models.transformer_lm import (
 from adapt_tpu.runtime.paged import Pager, insert_prefill_pages
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
+from adapt_tpu.utils.tracing import global_flight_recorder, global_tracer
 
 log = get_logger("continuous")
 
@@ -144,6 +157,9 @@ class _Request:
     stop: tuple[tuple[int, ...], ...] = ()
     #: Optional streaming callback (req_id, token, index) per commit.
     on_token: Callable[[int, int, int], None] | None = None
+    #: Lifecycle anchor (perf-counter clock, stamped by submit):
+    #: queue-wait, TTFT and request latency all measure from here.
+    t_submit: float = 0.0
 
 
 @dataclasses.dataclass
@@ -162,6 +178,16 @@ class _Slot:
     last_token: int = 0
     tokens: list = dataclasses.field(default_factory=list)
     lps: list = dataclasses.field(default_factory=list)
+    #: Timeline stamps (perf-counter): first emitted token (0.0 = none
+    #: yet) and last emitted token — feed the TTFT and
+    #: inter-token-latency histograms (queue wait measures from
+    #: ``req.t_submit`` at admission). ``obs_count`` is the token count
+    #: as of the last stamp: an ITL sample is recorded only when the
+    #: previous commit also stamped, so toggling ``obs_timeline`` off
+    #: and back on mid-request cannot inject one giant gap sample.
+    t_first: float = 0.0
+    t_last: float = 0.0
+    obs_count: int = 0
 
 
 class ContinuousBatcher:
@@ -350,6 +376,16 @@ class ContinuousBatcher:
         self._admitted = 0
         self._completed = 0
         self._ticks = 0
+        #: Request-timeline SLO histograms (queue-wait / TTFT /
+        #: inter-token-latency / request latency). ON by default — the
+        #: hot-path cost is one perf_counter stamp per committed token
+        #: (ITL samples batch into ONE registry-lock acquisition per
+        #: tick via observe_many); set False to measure the floor
+        #: (benchmarks/micro/obs_overhead.py). Flight-recorder lifecycle
+        #: events (admit/finish/cancel) are always-on, independent of
+        #: this flag.
+        self.obs_timeline = True
+        self._itl_pending: list[float] = []
         # Threaded serving (start()/result()/stop()): one condition
         # guards every mutation of the queue/done handoff state and the
         # server-thread lifecycle; compiled work runs outside the lock,
@@ -772,6 +808,7 @@ class ContinuousBatcher:
                 tuple(int(t) for t in seq) for seq in (stop or ())
             ),
             on_token=on_token,
+            t_submit=time.perf_counter(),
         )
         with self._cv:
             self._queue.append(req)
@@ -802,6 +839,9 @@ class ContinuousBatcher:
                     self._done[req_id] = np.zeros((0,), np.int32)
                     self._done_lps[req_id] = np.zeros((0,), np.float32)
                     self._cv.notify_all()
+                    global_flight_recorder().record(
+                        "cancel", request=req_id, state="queued"
+                    )
                     return True
             # Live = bound to a slot, or mid-admission on the ticking
             # thread (popped, not yet slot-bound). Anything else with a
@@ -815,15 +855,36 @@ class ContinuousBatcher:
             # Mark it; the ticking thread consumes the marker at its
             # next boundary.
             self._cancelled.add(req_id)
+            global_flight_recorder().record(
+                "cancel", request=req_id, state="live"
+            )
             return True
 
-    def _finish(self, slot: _Slot) -> None:
+    def _finish(self, slot: _Slot, reason: str = "completed") -> None:
         req = slot.req
+        if self.obs_timeline:
+            global_metrics().observe(
+                "continuous.request_latency_s",
+                time.perf_counter() - req.t_submit,
+            )
+        # Flight events stay UNGATED like cancel's: the recorder's
+        # contract is always-on per-lifecycle — a post-mortem must not
+        # show cancels for requests with no admit/finish.
+        global_flight_recorder().record(
+            "finish",
+            request=req.req_id,
+            reason=reason,
+            tokens=len(slot.tokens),
+        )
         with self._cv:
             self._done[req.req_id] = np.asarray(slot.tokens, np.int32)
             self._done_lps[req.req_id] = np.asarray(slot.lps, np.float32)
             while len(self._done_lps) > self._LPS_CAP:
-                self._done_lps.pop(next(iter(self._done_lps)))
+                evicted = next(iter(self._done_lps))
+                self._done_lps.pop(evicted)
+                global_flight_recorder().record(
+                    "lps_evicted", request=evicted
+                )
             # Consume any cancel marker that raced a natural finish —
             # markers must never outlive their request.
             self._cancelled.discard(req.req_id)
@@ -860,8 +921,29 @@ class ContinuousBatcher:
         if cancelled:
             # Partial stream becomes the result; the chunk's remaining
             # tokens for this slot are garbage nobody reads.
-            self._finish(slot)
+            self._finish(slot, reason="cancelled")
             return
+        if self.obs_timeline:
+            # One perf_counter stamp per committed token. TTFT observes
+            # inline (once per request); inter-token gaps batch into
+            # _itl_pending and flush under ONE registry-lock hold per
+            # tick (observe_many) — the hot-path contention stays O(1)
+            # per tick, not O(tokens). Contiguity guards make a
+            # mid-request obs_timeline toggle drop samples instead of
+            # corrupting them: TTFT only for the request's TRUE first
+            # token, ITL only when the previous commit also stamped.
+            now = time.perf_counter()
+            emitted_before = len(slot.tokens)
+            if slot.t_first == 0.0:
+                slot.t_first = now
+                if emitted_before == 0:
+                    global_metrics().observe(
+                        "continuous.ttft_s", now - req.t_submit
+                    )
+            elif slot.obs_count == emitted_before:
+                self._itl_pending.append(now - slot.t_last)
+            slot.t_last = now
+            slot.obs_count = emitted_before + 1
         slot.tokens.append(token)
         slot.lps.append(lp)
         if req.on_token is not None:
@@ -869,7 +951,7 @@ class ContinuousBatcher:
         if req.eos_id is not None and token == req.eos_id:
             # generate() pads with EOS forever after; a server frees the
             # slot instead — the emitted stream up to EOS is identical.
-            self._finish(slot)
+            self._finish(slot, reason="eos")
             return
         slot.emitted += 1
         slot.last_token = token
@@ -881,7 +963,7 @@ class ContinuousBatcher:
             if n and len(slot.tokens) >= n and tuple(
                 slot.tokens[-n:]
             ) == seq:
-                self._finish(slot)
+                self._finish(slot, reason="stop")
                 return
         if slot.emitted >= req.steps:
             self._finish(slot)
@@ -928,6 +1010,8 @@ class ContinuousBatcher:
                 and self._prefill_chunk is not None
                 and s0 - m * self._page > self._prefill_chunk
             )
+            tracer = global_tracer()
+            t0 = tracer.now() if tracer.enabled else 0.0
             first = None
             if chunked:
                 # Chunked prefill: park the slot in the prefilling state
@@ -1003,17 +1087,41 @@ class ContinuousBatcher:
                     self._pager.register(
                         owned[j], Pager.prefix_key(req.prompt, (j + 1) * self._page)
                     )
+            if tracer.enabled and not chunked:
+                tracer.add_span(
+                    "batcher.prefill",
+                    start=t0,
+                    end=tracer.now(),
+                    request=req.req_id,
+                    bucket=bucket,
+                    prefix_pages=m,
+                )
             slot.req = req
             slot.s0 = s0
             slot.pos = s0
             slot.emitted = 0
             slot.tokens = []
             slot.lps = []
+            slot.t_first = 0.0  # timeline: no token emitted yet
+            slot.obs_count = 0
             slot.pf_done = m * self._page if chunked else -1
             with self._cv:
                 self._admitting = None  # slot-bound: visible to cancel()
                 self._admitted += 1
             global_metrics().inc("continuous.admitted")
+            queue_wait = time.perf_counter() - req.t_submit
+            if self.obs_timeline:
+                global_metrics().observe(
+                    "continuous.queue_wait_s", queue_wait
+                )
+            global_flight_recorder().record(
+                "admit",
+                request=req.req_id,
+                slot=slot.idx,
+                prompt_len=s0,
+                chunked=chunked,
+                queue_wait_s=round(queue_wait, 6),
+            )
             if not chunked:
                 self._commit(slot, int(first[0]), float(first_lp[0]))
                 if slot.req is req:
@@ -1079,6 +1187,8 @@ class ContinuousBatcher:
         The final pass samples the first token and flips the slot into
         the decode batch."""
         req, s0, P = slot.req, slot.s0, self._page
+        tracer = global_tracer()
+        t0 = tracer.now() if tracer.enabled else 0.0
         pos0 = slot.pf_done  # page-aligned (chunks are page multiples)
         clen = min(self._prefill_chunk, s0 - pos0)
         final = pos0 + clen >= s0
@@ -1114,6 +1224,16 @@ class ContinuousBatcher:
             nucleus=final and req.top_p < 1.0,
         )
         slot.pf_done = pos0 + clen
+        if tracer.enabled:
+            tracer.add_span(
+                "batcher.prefill_chunk",
+                start=t0,
+                end=tracer.now(),
+                request=req.req_id,
+                pos0=int(pos0),
+                chunk_len=int(clen),
+                final=final,
+            )
         if final:
             for j in range(s0 // P):  # register() skips known keys
                 self._pager.register(
@@ -1139,7 +1259,7 @@ class ContinuousBatcher:
                 cancelled = slot.req.req_id in self._cancelled
                 self._cancelled.discard(slot.req.req_id)
             if cancelled:  # mid-prefill or between chunks
-                self._finish(slot)
+                self._finish(slot, reason="cancelled")
         for slot in self.slots:
             if slot.req is not None and slot.pf_done >= 0:
                 self._prefill_step(slot)  # interleaves with decode below
@@ -1162,6 +1282,12 @@ class ContinuousBatcher:
                 if s.req is not None and s.pf_done >= 0),
         )
         global_metrics().set_gauge("continuous.queue_depth", len(self._queue))
+        # Bridge PR-1's fused-staging counter to /metrics: transfers are
+        # cumulative, so dashboards derive the steady-state rate (the
+        # contract: flat between admissions).
+        global_metrics().set_gauge(
+            "continuous.h2d_transfers", float(self._h2d_count)
+        )
         if not active:
             return 0
         C = self.chunk
@@ -1173,6 +1299,8 @@ class ContinuousBatcher:
         # and the paged table re-uploads only when it changed.
         truncate = any(s.req.top_k < self.lm.vocab for s in active)
         nucleus = any(s.req.top_p < 1.0 for s in active)
+        tracer = global_tracer()
+        t_chunk = tracer.now() if tracer.enabled else 0.0
         toks, lps, self._caches, self._dstate = self._step_chunk(
             self.variables,
             self._caches,
@@ -1187,6 +1315,16 @@ class ContinuousBatcher:
         # The chunk's ONE host sync fetches both arrays together.
         toks, lps = jax.device_get((toks, lps))
         toks, lps = np.asarray(toks), np.asarray(lps)
+        if tracer.enabled:
+            # Dispatch + host sync of one compiled decode chunk — the
+            # Perfetto row that shows tick cadence and chunk cost.
+            tracer.add_span(
+                "batcher.decode_chunk",
+                start=t_chunk,
+                end=tracer.now(),
+                slots=len(active),
+                chunk=C,
+            )
         for i, slot in enumerate(self.slots):
             if slot.req is None or slot.pf_done >= 0:
                 continue
@@ -1213,6 +1351,13 @@ class ContinuousBatcher:
                 ) // self._page - self._pager.base(slot.idx)
                 if dead > 0:
                     self._pager.release_prefix(slot.idx, dead)
+        # Flush the tick's inter-token-latency samples in ONE registry
+        # lock acquisition (not one per committed token).
+        if self._itl_pending:
+            global_metrics().observe_many(
+                "continuous.itl_s", self._itl_pending
+            )
+            self._itl_pending = []
         # Post-commit occupancy: slots retired by this chunk are gone.
         global_metrics().set_gauge(
             "continuous.active_slots",

@@ -56,6 +56,7 @@ from adapt_tpu.models.transformer_lm import (
 )
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
+from adapt_tpu.utils.tracing import global_flight_recorder, global_tracer
 
 log = get_logger("decode_pipeline")
 
@@ -358,7 +359,8 @@ class PipelinedDecoder:
             )
             for m in range(M)
         ]
-        deadlines: dict[int, tuple[float, int, int]] = {}  # rid -> (t, m, stage)
+        # rid -> (deadline, microbatch, stage, submit perf-time)
+        deadlines: dict[int, tuple[float, int, int, float]] = {}
         # Consecutive unrecovered faults (reset whenever any microbatch
         # makes progress): bounds a flapping stage without capping how
         # many *independent* faults a long session may survive.
@@ -413,6 +415,7 @@ class PipelinedDecoder:
                 + self.fault.task_deadline_s * (depth_ahead + 1),
                 m,
                 st.stage,
+                time.perf_counter(),  # span anchor: submit -> result
             )
             self.workers[prog.index].submit(
                 Task(
@@ -462,17 +465,33 @@ class PipelinedDecoder:
             if res is not None:
                 if res.attempt != self.epoch or res.request_id not in deadlines:
                     continue  # stale epoch / already-recovered task
-                _, m, stage = deadlines.pop(res.request_id)
+                _, m, stage, t_sub = deadlines.pop(res.request_id)
                 if res.error is not None:
                     log.error(
                         "decode stage %d failed: %s", stage, res.error
                     )
                     failed_stage = stage
                 else:
+                    tracer = global_tracer()
+                    if tracer.enabled:
+                        # Submit -> result for one (microbatch, stage)
+                        # pass, tagged with the task's request/attempt
+                        # ids (attempt == recovery epoch) — the stitched
+                        # timeline that shows pipeline occupancy and
+                        # where a recovery re-drove the session.
+                        tracer.add_span(
+                            "decode.pass",
+                            start=t_sub,
+                            end=tracer.now(),
+                            request=res.request_id,
+                            attempt=res.attempt,
+                            microbatch=m,
+                            stage=stage,
+                        )
                     advance(m, *res.output)
             if failed_stage is None:
                 now = time.monotonic()
-                for _rid, (t, _m, stage) in deadlines.items():
+                for _rid, (t, _m, stage, _t0) in deadlines.items():
                     if t < now:
                         failed_stage = stage
                         log.warning(
@@ -480,6 +499,11 @@ class PipelinedDecoder:
                             "(worker %s dead or hung)",
                             stage,
                             self.workers[stage].worker_id,
+                        )
+                        global_flight_recorder().record(
+                            "decode_deadline_miss",
+                            stage=stage,
+                            worker=self.workers[stage].worker_id,
                         )
                         break
             if failed_stage is not None:
@@ -619,4 +643,11 @@ class PipelinedDecoder:
             stage,
             time.monotonic() - t0,
             self.epoch,
+        )
+        global_flight_recorder().record(
+            "decode_recovery",
+            stage=stage,
+            epoch=self.epoch,
+            worker=self.workers[stage].worker_id,
+            duration_s=round(time.monotonic() - t0, 4),
         )

@@ -31,6 +31,7 @@ from adapt_tpu.core.stage import CompiledStage, compile_stages
 from adapt_tpu.graph.partition import PartitionPlan
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
+from adapt_tpu.utils.tracing import global_tracer
 
 log = get_logger("pipeline")
 
@@ -164,34 +165,50 @@ class LocalPipeline:
             for _ in range(n_stages)
         ]
 
+        tracer = global_tracer()
+
         def stage_loop(i: int):
             stage = self.stages[i]
             out_q = hop_qs[i] or qs[i + 1]
+            seq = 0
             while True:
                 item = get_or_abort(qs[i])
                 if item is _SENTINEL or isinstance(item, _StageError):
                     put_or_abort(out_q, item)
                     break
                 try:
-                    y = stage(item)
+                    # Span = the jit DISPATCH (XLA compute is async);
+                    # `seq` is the stream ordinal — together with the
+                    # hop spans below, Perfetto shows stage i computing
+                    # request r+1 while its hop for r is in flight.
+                    with tracer.span(
+                        "pipeline.stage", stage=i, seq=seq
+                    ):
+                        y = stage(item)
                 except Exception as e:  # noqa: BLE001 — surface to caller
                     put_or_abort(out_q, _StageError(stage.spec.index, e))
                     break
+                seq += 1
                 if not put_or_abort(out_q, y):
                     break
 
         def hop_loop(i: int):
             stage = self.stages[i]
+            seq = 0
             while True:
                 y = get_or_abort(hop_qs[i])
                 if y is _SENTINEL or isinstance(y, _StageError):
                     put_or_abort(qs[i + 1], y)
                     break
                 try:
-                    y = self.hop_transform(y, stage.spec.index)
+                    # The blocking host round-trip (codec fetch/encode):
+                    # the span PR-1's hop threads exist to overlap.
+                    with tracer.span("pipeline.hop", stage=i, seq=seq):
+                        y = self.hop_transform(y, stage.spec.index)
                 except Exception as e:  # noqa: BLE001 — surface to caller
                     put_or_abort(qs[i + 1], _StageError(stage.spec.index, e))
                     break
+                seq += 1
                 if not put_or_abort(qs[i + 1], y):
                     break
 
@@ -377,3 +394,15 @@ class ServingPipeline:
 
     def metrics(self) -> dict:
         return global_metrics().snapshot()
+
+    def serve_observability(self, port: int = 0, host: str = "127.0.0.1"):
+        """Start the process observability exporter (``/metrics``,
+        ``/trace.json``, ``/debug/events`` — see ``utils.exporter``) on
+        a daemon thread; returns the HTTP server (``.server_address[1]``
+        is the bound port; ``port=0`` picks a free one). The endpoints
+        cover everything in this process: this pipeline's dispatcher and
+        workers, any ContinuousBatcher, the tracer ring and the flight
+        recorder."""
+        from adapt_tpu.utils.exporter import serve_metrics
+
+        return serve_metrics(port=port, host=host)

@@ -1,7 +1,12 @@
 from adapt_tpu.utils.exporter import prometheus_text, serve_metrics
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import MetricsRegistry, global_metrics
-from adapt_tpu.utils.tracing import Tracer, global_tracer
+from adapt_tpu.utils.tracing import (
+    FlightRecorder,
+    Tracer,
+    global_flight_recorder,
+    global_tracer,
+)
 
 __all__ = [
     "get_logger",
@@ -9,6 +14,8 @@ __all__ = [
     "global_metrics",
     "prometheus_text",
     "serve_metrics",
+    "FlightRecorder",
+    "global_flight_recorder",
     "Tracer",
     "global_tracer",
 ]

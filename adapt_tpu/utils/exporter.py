@@ -1,13 +1,21 @@
-"""HTTP metrics exporter: scrape the process's MetricsRegistry.
+"""HTTP observability exporter: metrics, traces, flight-recorder events.
 
 The observability surface SURVEY.md §5 calls for, made scrapeable: a
 stdlib ``ThreadingHTTPServer`` serving
 
-- ``GET /metrics`` — Prometheus text exposition (counters as
-  ``adapt_<name>_total``, gauges as ``adapt_<name>``, histograms as
-  ``_count`` / ``_sum`` plus p50/p99 gauges; dots in metric names become
+- ``GET /metrics`` — Prometheus text exposition with ``# HELP`` /
+  ``# TYPE`` lines (counters as ``adapt_<name>_total``, gauges as
+  ``adapt_<name>``, histograms as a ``summary`` family of ``_count`` /
+  ``_sum`` plus p50/p99 gauges; dots in metric names become
   underscores),
 - ``GET /metrics.json`` — the raw :meth:`MetricsRegistry.snapshot`,
+- ``GET /trace.json`` — the :class:`~adapt_tpu.utils.tracing.Tracer`
+  ring as Chrome trace-event JSON: save it (or fetch it with curl) and
+  open in https://ui.perfetto.dev or ``chrome://tracing`` to see the
+  serving timeline — per-stage spans, hop/compute overlap, and remote
+  workers' stitched spans on their own process rows,
+- ``GET /debug/events`` — the flight recorder's structured event ring
+  (admissions, re-dispatches, quarantines, probe misses, recoveries),
 - ``GET /healthz`` — ``{"ok": true}`` liveness.
 
 Serving-side components (dispatcher, continuous batcher, gateway) all
@@ -29,6 +37,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import MetricsRegistry, global_metrics
+from adapt_tpu.utils.tracing import (
+    FlightRecorder,
+    Tracer,
+    global_flight_recorder,
+    global_tracer,
+)
 
 log = get_logger("exporter")
 
@@ -39,23 +53,38 @@ def _prom_name(name: str) -> str:
     return "adapt_" + _NAME_RE.sub("_", name)
 
 
+def _family(lines: list[str], name: str, mtype: str, help_: str) -> None:
+    lines.append(f"# HELP {name} {help_}")
+    lines.append(f"# TYPE {name} {mtype}")
+
+
 def prometheus_text(snapshot: dict) -> str:
     """Render a :meth:`MetricsRegistry.snapshot` in the Prometheus text
-    exposition format (one line per sample; histograms as count/sum +
-    percentile gauges — enough for dashboards without native histogram
-    buckets)."""
+    exposition format. Every sample family gets ``# HELP``/``# TYPE``
+    lines (scrapers and promtool-style parsers want them); histograms
+    render as a ``summary`` family (count/sum) plus percentile gauges —
+    enough for dashboards without native histogram buckets."""
     lines: list[str] = []
     for name, value in sorted(snapshot.get("counters", {}).items()):
-        lines.append(f"{_prom_name(name)}_total {value}")
+        pname = _prom_name(name) + "_total"
+        _family(lines, pname, "counter", f"cumulative count of {name}")
+        lines.append(f"{pname} {value}")
     for name, value in sorted(snapshot.get("gauges", {}).items()):
-        lines.append(f"{_prom_name(name)} {value}")
+        pname = _prom_name(name)
+        _family(lines, pname, "gauge", f"current value of {name}")
+        lines.append(f"{pname} {value}")
     for name, summ in sorted(snapshot.get("histograms", {}).items()):
         base = _prom_name(name)
+        _family(lines, base, "summary", f"distribution of {name}")
         lines.append(f"{base}_count {summ.get('count', 0)}")
         if summ.get("count"):
             lines.append(f"{base}_sum {summ['sum']}")
             for p in ("p50", "p99"):
-                lines.append(f"{base}_{p} {summ[p]}")
+                pname = f"{base}_{p}"
+                _family(
+                    lines, pname, "gauge", f"{p} of {name} (reservoir)"
+                )
+                lines.append(f"{pname} {summ[p]}")
     return "\n".join(lines) + "\n"
 
 
@@ -63,23 +92,48 @@ def serve_metrics(
     port: int = 9100,
     host: str = "127.0.0.1",
     registry: MetricsRegistry | None = None,
+    tracer: Tracer | None = None,
+    recorder: FlightRecorder | None = None,
 ) -> ThreadingHTTPServer:
     """Start the exporter on a daemon thread; returns the server
     (``.server_address[1]`` is the bound port). Stop with
     ``.shutdown()`` then ``.server_close()`` — shutdown alone stops the
-    loop but leaks the listening socket. ``registry`` defaults to the
-    process-global one."""
+    loop but leaks the listening socket. ``registry``/``tracer``/
+    ``recorder`` default to the process-global ones."""
     reg = registry if registry is not None else global_metrics()
+    tr = tracer if tracer is not None else global_tracer()
+    rec = recorder if recorder is not None else global_flight_recorder()
+    # Pull-side bridges: codec registers its copy-stats collector on the
+    # GLOBAL registry at import; re-register it on the registry actually
+    # being served, so custom-registry exporters (tests, multi-tenant
+    # processes) get codec.copy_{bytes,calls} too. register_collector is
+    # idempotent per function. Function-scoped import: utils must not
+    # depend on comm at module level.
+    from adapt_tpu.comm.codec import _copy_stats_collector
+
+    reg.register_collector(_copy_stats_collector)
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 — http.server API
-            if self.path == "/metrics":
+            path = self.path.split("?", 1)[0]
+            if path == "/metrics":
                 body = prometheus_text(reg.snapshot()).encode()
                 ctype = "text/plain; version=0.0.4"
-            elif self.path == "/metrics.json":
+            elif path == "/metrics.json":
                 body = json.dumps(reg.snapshot()).encode()
                 ctype = "application/json"
-            elif self.path == "/healthz":
+            elif path == "/trace.json":
+                # default=str: one non-JSON span attr / event value
+                # (numpy scalar, exception object) must degrade to its
+                # repr, not turn every scrape into a 500.
+                body = json.dumps(
+                    tr.to_chrome_trace(), default=str
+                ).encode()
+                ctype = "application/json"
+            elif path == "/debug/events":
+                body = json.dumps(rec.snapshot(), default=str).encode()
+                ctype = "application/json"
+            elif path == "/healthz":
                 body = b'{"ok": true}'
                 ctype = "application/json"
             else:

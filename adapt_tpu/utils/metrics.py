@@ -4,31 +4,62 @@ The reference measures exactly one thing: wall-clock req/s in the driver
 (``/root/reference/test/test.py:25,34-37``). The framework exports the
 metrics SURVEY.md §5 calls for: req/s, per-stage latency, recovery time,
 re-dispatch counts — cheap, lock-guarded, snapshot-able.
+
+Percentiles come from a DETERMINISTIC DECIMATING reservoir: the sample
+buffer is bounded, and when it fills, every other retained sample is
+dropped and the sampling stride doubles — so the reservoir always spans
+the histogram's whole history (early and late observations alike) in
+bounded memory. A keep-the-first-N reservoir freezes p50/p99 at the
+warm-up distribution forever; this one shifts as traffic shifts
+(``tests/test_observability.py`` pins that).
+
+``register_collector`` hooks pull-style sources (module counters like
+``comm.codec.copy_stats``) into :meth:`snapshot`: collectors run at
+scrape time, right before the snapshot is taken, so ``/metrics`` shows
+their current values without a push on every hot-path mutation.
 """
 
 from __future__ import annotations
 
 import threading
 from collections import defaultdict
+from collections.abc import Callable, Iterable
 
 
 class _Histogram:
-    __slots__ = ("count", "total", "min", "max", "_samples")
+    #: Reservoir cap: when full, every other sample is discarded and the
+    #: sampling stride doubles (memory stays O(cap), coverage stays the
+    #: whole stream).
+    _CAP = 4096
+
+    __slots__ = ("count", "total", "min", "max", "_samples", "_stride",
+                 "_skip")
 
     def __init__(self):
         self.count = 0
         self.total = 0.0
         self.min = float("inf")
         self.max = float("-inf")
-        self._samples: list[float] = []  # reservoir, capped
+        self._samples: list[float] = []  # decimating reservoir, capped
+        self._stride = 1  # keep every _stride-th observation
+        self._skip = 0  # observations left to skip before the next keep
 
     def observe(self, v: float) -> None:
         self.count += 1
         self.total += v
         self.min = min(self.min, v)
         self.max = max(self.max, v)
-        if len(self._samples) < 4096:
-            self._samples.append(v)
+        # Deterministic decimation: unlike keep-first-N (which freezes
+        # percentiles at the warm-up distribution), every epoch of the
+        # stream stays represented at equal stride.
+        if self._skip:
+            self._skip -= 1
+            return
+        self._samples.append(v)
+        if len(self._samples) >= self._CAP:
+            del self._samples[::2]  # halve, oldest-first interleaved
+            self._stride *= 2
+        self._skip = self._stride - 1
 
     def percentile(self, p: float) -> float:
         if not self._samples:
@@ -65,6 +96,7 @@ class MetricsRegistry:
         self._counters: dict[str, float] = defaultdict(float)
         self._gauges: dict[str, float] = {}
         self._histograms: dict[str, _Histogram] = defaultdict(_Histogram)
+        self._collectors: list[Callable[["MetricsRegistry"], None]] = []
 
     def inc(self, name: str, value: float = 1.0) -> None:
         with self._lock:
@@ -78,11 +110,40 @@ class MetricsRegistry:
         with self._lock:
             self._histograms[name].observe(value)
 
+    def observe_many(self, name: str, values: Iterable[float]) -> None:
+        """Batch observe under ONE lock acquisition — the serving paths
+        (per-token inter-token latencies) flush a tick's samples in one
+        call instead of contending per token."""
+        values = list(values)
+        if not values:
+            return
+        with self._lock:
+            h = self._histograms[name]
+            for v in values:
+                h.observe(v)
+
     def counter(self, name: str) -> float:
         with self._lock:
             return self._counters.get(name, 0.0)
 
+    def register_collector(
+        self, fn: Callable[["MetricsRegistry"], None]
+    ) -> None:
+        """Register a pull hook run at the top of every :meth:`snapshot`
+        (outside the lock — collectors call ``set_gauge``/``inc``
+        themselves). Idempotent per function object."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
     def snapshot(self) -> dict:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn(self)
+            except Exception:  # noqa: BLE001 — a scrape must not fail
+                pass
         with self._lock:
             return {
                 "counters": dict(self._counters),
@@ -93,6 +154,7 @@ class MetricsRegistry:
             }
 
     def reset(self) -> None:
+        """Clear all recorded values (collectors stay registered)."""
         with self._lock:
             self._counters.clear()
             self._gauges.clear()

@@ -1,0 +1,136 @@
+"""Observability overhead on the steady-state decode tick.
+
+The instrumentation contract (ISSUE 2): request timelines + tracing must
+be cheap enough to leave on. Disabled, the only residue is one branch
+per site (``obs_timeline`` False + tracer off == pre-PR tick); enabled,
+the budget is < 5% added tick wall time on CPU.
+
+Three configurations over the SAME ContinuousBatcher steady state
+(all slots decoding, no admissions, chunked ticks):
+
+- ``off``     — ``obs_timeline=False``, tracer disabled (the floor).
+- ``timeline``— default serving config: TTFT/ITL/queue-wait histograms
+  + flight-recorder lifecycle events (tracer still off).
+- ``trace``   — timeline + the span ring (prefill/decode-chunk spans).
+
+One JSON line: value = enabled ("trace") overhead vs the floor in
+percent; ``vs_baseline`` = the 5% budget minus the measured overhead
+(positive = within budget). Per-config per-tick means ride in extras.
+
+Timing note (benchmarks/common.py): ticks end in a real host fetch of
+the chunk's tokens, so the region is honestly bounded per tick.
+
+Usage: ``python benchmarks/micro/obs_overhead.py [--slots 4]
+[--ticks 40] [--trials 5]``
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks.common import emit, int_flag  # noqa: E402
+
+BUDGET_PCT = 5.0
+
+
+def main() -> int:
+    slots = int_flag(sys.argv, "--slots", 4)
+    n_ticks = int_flag(sys.argv, "--ticks", 40)
+    trials = int_flag(sys.argv, "--trials", 5)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import jax
+        import numpy as np
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+
+        from adapt_tpu.models.transformer_lm import lm_tiny
+        from adapt_tpu.runtime.continuous import ContinuousBatcher
+        from adapt_tpu.utils.tracing import global_tracer
+
+        chunk = 8
+        # Requests must OUTLIVE every measured window (warmup + 3
+        # configs x trials x n_ticks), or late ticks measure an idle
+        # batcher: size max_len from the measurement plan.
+        total_ticks = n_ticks * (3 * trials + 1) + 8
+        steps = total_ticks * chunk
+        lm = lm_tiny(vocab=37, max_len=steps + 16)
+        variables = lm.graph.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+        )
+        bat = ContinuousBatcher(lm, variables, slots=slots, chunk=chunk)
+        rng = np.random.RandomState(0)
+        for _ in range(slots):
+            bat.submit(rng.randint(0, 37, size=6).astype(np.int32), steps)
+        bat.tick()  # admission burst + compiles
+        bat.tick()
+
+        tracer = global_tracer()
+        for _ in range(n_ticks):  # warm caches before ANY timed window
+            bat.tick()
+
+        configs = {  # name -> (obs_timeline, tracer.enabled)
+            "off": (False, False),
+            "timeline": (True, False),
+            "trace": (True, True),
+        }
+        best = {name: float("inf") for name in configs}
+        # Round-robin trials + best-of, ROTATING the config order each
+        # trial: tick cost grows with sequence position (longer
+        # attention window), so a fixed order would hand the
+        # first-measured config the cheapest positions every trial.
+        names = list(configs)
+        for t in range(trials):
+            for name in names[t % 3:] + names[: t % 3]:
+                timeline, trace = configs[name]
+                bat.obs_timeline = timeline
+                tracer.enabled = trace
+                t0 = time.perf_counter()
+                for _ in range(n_ticks):
+                    bat.tick()
+                best[name] = min(
+                    best[name], (time.perf_counter() - t0) / n_ticks
+                )
+        t_off, t_timeline, t_trace = (
+            best["off"], best["timeline"], best["trace"]
+        )
+        tracer.enabled = False
+        still_active = bat.stats()["active"]
+        if still_active != slots:
+            raise RuntimeError(
+                f"batcher fell out of steady state mid-measure "
+                f"({still_active}/{slots} slots active)"
+            )
+        overhead_pct = (t_trace / t_off - 1.0) * 100.0
+        emit(
+            "micro_obs_overhead_pct",
+            overhead_pct,
+            "% tick wall time (trace+timeline vs off)",
+            BUDGET_PCT - overhead_pct,
+            budget_pct=BUDGET_PCT,
+            tick_off_ms=round(t_off * 1e3, 4),
+            tick_timeline_ms=round(t_timeline * 1e3, 4),
+            tick_trace_ms=round(t_trace * 1e3, 4),
+            timeline_only_pct=round((t_timeline / t_off - 1.0) * 100.0, 3),
+            slots=slots,
+            ticks=n_ticks,
+            trials=trials,
+            chunk=bat.chunk,
+        )
+    except Exception as e:  # noqa: BLE001 — always one JSON line, rc 0
+        emit(
+            "micro_obs_overhead_pct", 0.0,
+            "% tick wall time (trace+timeline vs off)", 0.0,
+            error=str(e)[-300:],
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,523 @@
+"""Observability-layer tests (ISSUE 2): decimating histogram reservoir,
+span ring + Chrome-trace export, Prometheus exposition under concurrent
+traffic, ContinuousBatcher request timelines on /metrics, the flight
+recorder, and cross-process span stitching over a real remote worker."""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adapt_tpu.comm.framing import MSG_DATA, MSG_RESULT, Message, recv_msg, send_msg
+from adapt_tpu.utils.metrics import MetricsRegistry, global_metrics
+from adapt_tpu.utils.tracing import (
+    FlightRecorder,
+    Tracer,
+    export_spans,
+    global_flight_recorder,
+    global_tracer,
+)
+from conftest import spawn_worker_proc
+
+
+@pytest.fixture
+def clean_obs():
+    """Snapshot/restore the process-global tracer + flight recorder so
+    tests that enable tracing can't leak span recording into the rest
+    of the suite."""
+    tracer = global_tracer()
+    recorder = global_flight_recorder()
+    was_enabled = tracer.enabled
+    yield tracer, recorder
+    tracer.enabled = was_enabled
+    tracer.clear()
+    recorder.clear()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return r.read().decode(), r.headers.get("Content-Type")
+
+
+# -- histogram reservoir ----------------------------------------------------
+
+
+def test_histogram_reservoir_tracks_late_samples():
+    """Regression (satellite 1): the old reservoir kept only the FIRST
+    4096 observations, so percentiles froze at the warm-up distribution.
+    The decimating reservoir must let late samples move p50/p99."""
+    reg = MetricsRegistry()
+    for _ in range(5000):
+        reg.observe("lat", 1.0)
+    warm = reg.snapshot()["histograms"]["lat"]
+    assert warm["p99"] == 1.0
+    for _ in range(5000):
+        reg.observe("lat", 100.0)
+    s = reg.snapshot()["histograms"]["lat"]
+    assert s["count"] == 10000
+    assert s["min"] == 1.0 and s["max"] == 100.0
+    # The old code would report p99 == 1.0 forever (the second 5000
+    # observations never entered the reservoir).
+    assert s["p99"] == 100.0
+    # Roughly half the reservoir mass is late: p25-ish stays early,
+    # p75-ish must be late.
+    h = reg._histograms["lat"]
+    assert h.percentile(75) == 100.0
+    assert h.percentile(25) == 1.0
+
+
+def test_histogram_reservoir_bounded_memory():
+    reg = MetricsRegistry()
+    for i in range(100_000):
+        reg.observe("lat", float(i % 977))
+    h = reg._histograms["lat"]
+    assert len(h._samples) <= 4096
+    assert h.count == 100_000
+    # Summary stays exact for count/sum/min/max regardless of decimation.
+    s = h.summary()
+    assert s["count"] == 100_000
+    assert s["min"] == 0.0 and s["max"] == 976.0
+
+
+def test_observe_many_matches_observe():
+    reg = MetricsRegistry()
+    reg.observe_many("h", [1.0, 2.0, 3.0])
+    reg.observe_many("h", [])  # no-op, no lock churn
+    s = reg.snapshot()["histograms"]["h"]
+    assert s["count"] == 3 and s["sum"] == 6.0
+
+
+# -- tracer ring ------------------------------------------------------------
+
+
+def test_tracer_ring_overwrites_and_counts_drops():
+    """Satellite 2: a full span buffer must RING (newest spans survive),
+    not silently drop everything after capacity."""
+    before = global_metrics().counter("tracer.spans_dropped")
+    tr = Tracer(capacity=4)
+    tr.enabled = True
+    for i in range(10):
+        with tr.span("s", i=i):
+            pass
+    spans = tr.spans("s")
+    assert len(spans) == 4
+    assert [s.attrs["i"] for s in spans] == [6, 7, 8, 9]  # newest kept
+    assert tr.spans_dropped == 6
+    # Mirrored into the process registry for /metrics.
+    assert global_metrics().counter("tracer.spans_dropped") - before == 6
+
+
+def test_tracer_disabled_records_nothing():
+    tr = Tracer(capacity=8)
+    assert tr.enabled is False
+    with tr.span("s") as sp:
+        assert sp is None
+    assert tr.spans() == [] and tr.spans_dropped == 0
+
+
+def test_chrome_trace_export_is_valid(clean_obs):
+    """Satellite 5: to_chrome_trace() output is valid Chrome trace-event
+    JSON — loads, and every event has ph/ts/pid (the structural contract
+    Perfetto needs)."""
+    tr = Tracer(capacity=16)
+    tr.enabled = True
+    with tr.span("outer", request=7):
+        with tr.span("inner", request=7, stage=0):
+            pass
+    blob = json.dumps(tr.to_chrome_trace())
+    doc = json.loads(blob)
+    events = doc["traceEvents"]
+    assert events, "no events exported"
+    for ev in events:
+        assert {"ph", "ts", "pid", "name"} <= set(ev)
+    xs = [e for e in events if e["ph"] == "X"]
+    assert len(xs) == 2
+    for ev in xs:
+        assert ev["dur"] >= 0.0
+        assert ev["args"]["request"] == 7
+        assert ev["tid"] != 0
+    # Process metadata row present (Perfetto labels the track with it).
+    assert any(e["ph"] == "M" and e["name"] == "process_name" for e in events)
+
+
+def test_span_export_ingest_roundtrip_preserves_origin():
+    """The stitching primitive: spans exported in one process ingest
+    into another tracer keeping their pid/tid and wall-clock position."""
+    src = Tracer(capacity=8)
+    src.enabled = True
+    with src.span("remote.stage_exec", request=3, attempt=1) as sp:
+        time.sleep(0.01)
+    exported = export_spans([sp, None])  # None entries skipped
+    assert len(exported) == 1
+    # Simulate arrival in a different process: alien pid survives.
+    exported[0]["pid"] = 424242
+    dst = Tracer(capacity=8)
+    dst.enabled = True
+    dst.ingest(exported)
+    got = dst.spans("remote.stage_exec")
+    assert len(got) == 1
+    assert got[0].pid == 424242
+    assert got[0].attrs["request"] == 3
+    assert got[0].duration == pytest.approx(sp.duration, rel=0.05)
+    trace = dst.to_chrome_trace()
+    pids = {e["pid"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert pids == {424242}
+    # Garbage tolerance: a corrupt annex (non-list JSON, junk entries)
+    # must never raise out of ingest — it would kill a proxy read loop.
+    before = global_metrics().counter("tracer.ingest_rejected")
+    dst.ingest(None)
+    dst.ingest(42)
+    dst.ingest(["junk", {"name": "x"}, {"name": "ok", "t0": 1.0, "t1": 2.0}])
+    assert len(dst.spans()) == 2  # only the well-formed entry landed
+    assert global_metrics().counter("tracer.ingest_rejected") - before == 4
+
+
+# -- framing annex ----------------------------------------------------------
+
+
+def test_framing_annex_roundtrip_over_socketpair():
+    """The flags-byte annex: rides before the payload, length-prefixed;
+    payload content and the no-annex path are unchanged."""
+    a, b = socket.socketpair()
+    try:
+        annex = json.dumps([{"name": "s", "t0": 1.0, "t1": 2.0}]).encode()
+        payload = [b"\x01" * 1000, b"\x02" * 500]  # multi-part scatter
+        t = threading.Thread(
+            target=send_msg,
+            args=(a, Message(MSG_RESULT, 1, 42, 0, payload, annex=annex)),
+        )
+        t.start()
+        got = recv_msg(b)
+        t.join()
+        assert got.annex == annex
+        assert bytes(got.payload) == b"\x01" * 1000 + b"\x02" * 500
+        assert (got.msg_type, got.stage_index, got.request_id) == (
+            MSG_RESULT, 1, 42,
+        )
+        # No annex -> flags 0 -> annex None on receive.
+        t = threading.Thread(
+            target=send_msg, args=(a, Message(MSG_DATA, 0, 1, 0, b"xy"))
+        )
+        t.start()
+        got = recv_msg(b)
+        t.join()
+        assert got.annex is None and bytes(got.payload) == b"xy"
+    finally:
+        a.close()
+        b.close()
+
+
+# -- exporter ---------------------------------------------------------------
+
+
+def test_prometheus_exposition_has_help_type_and_parses_under_load():
+    """Satellite 3: # HELP/# TYPE lines present, and a scrape racing
+    heavy observe() traffic returns parseable output every time."""
+    from adapt_tpu.utils.exporter import serve_metrics
+
+    reg = MetricsRegistry()
+    reg.inc("burst.completed")
+    stop = threading.Event()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            reg.observe("burst.latency_s", (i % 100) / 100.0)
+            reg.inc("burst.completed")
+            i += 1
+
+    threads = [threading.Thread(target=hammer, daemon=True) for _ in range(4)]
+    for t in threads:
+        t.start()
+    server = serve_metrics(port=0, registry=reg)
+    try:
+        port = server.server_address[1]
+        text = ""
+        for _ in range(10):
+            text, ctype = _get(port, "/metrics")
+            assert "text/plain" in ctype
+            for line in text.splitlines():
+                if not line:
+                    continue
+                if line.startswith("#"):
+                    assert line.startswith(("# HELP ", "# TYPE ")), line
+                    continue
+                name, value = line.rsplit(" ", 1)
+                float(value)  # every sample line parses
+        assert "# TYPE adapt_burst_completed_total counter" in text
+        assert "# TYPE adapt_burst_latency_s summary" in text
+        assert "# TYPE adapt_burst_latency_s_p50 gauge" in text
+        assert "adapt_burst_latency_s_count" in text
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=2)
+        server.shutdown()
+        server.server_close()
+
+
+def test_exporter_trace_events_404_and_free_port(clean_obs):
+    """Satellite 5: /trace.json + /debug/events endpoints, the 404 path,
+    and port=0 free-port binding (two servers can't collide)."""
+    from adapt_tpu.utils.exporter import serve_metrics
+
+    tr = Tracer(capacity=8)
+    tr.enabled = True
+    with tr.span("stage_exec", request=1):
+        pass
+    rec = FlightRecorder(capacity=8)
+    rec.record("admit", request=1)
+    s1 = serve_metrics(port=0, tracer=tr, recorder=rec)
+    s2 = serve_metrics(port=0, tracer=tr, recorder=rec)
+    try:
+        p1 = s1.server_address[1]
+        p2 = s2.server_address[1]
+        assert p1 != 0 and p2 != 0 and p1 != p2  # real, distinct ports
+
+        body, ctype = _get(p1, "/trace.json")
+        assert "application/json" in ctype
+        doc = json.loads(body)
+        assert any(
+            e.get("ph") == "X" and e["name"] == "stage_exec"
+            for e in doc["traceEvents"]
+        )
+
+        body, ctype = _get(p1, "/debug/events")
+        assert "application/json" in ctype
+        events = json.loads(body)["events"]
+        assert events and events[-1]["kind"] == "admit"
+        assert events[-1]["data"]["request"] == 1
+
+        for bad in ("/nope", "/trace", "/debug"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(p1, bad)
+            assert ei.value.code == 404
+    finally:
+        for s in (s1, s2):
+            s.shutdown()
+            s.server_close()
+
+
+# -- flight recorder --------------------------------------------------------
+
+
+def test_flight_recorder_ring_and_snapshot(tmp_path):
+    rec = FlightRecorder(capacity=3)
+    for i in range(5):
+        rec.record("redispatch", request=i)
+    evs = rec.events("redispatch")
+    assert [e["data"]["request"] for e in evs] == [2, 3, 4]  # newest kept
+    assert rec.events_dropped == 2
+    assert rec.events("quarantine") == []
+    snap = rec.snapshot()
+    assert snap["dropped"] == 2 and len(snap["events"]) == 3
+    path = rec.snapshot_to(str(tmp_path / "flight.json"))
+    loaded = json.load(open(path))
+    assert [e["data"]["request"] for e in loaded["events"]] == [2, 3, 4]
+    assert all("ts" in e and "kind" in e for e in loaded["events"])
+    # A writer that recorded a non-JSON value must not make the dump
+    # (or the /debug/events scrape) raise: default=str degrades it.
+    rec.record("weird", err=ValueError("boom"), arr=np.float32(1.5))
+    path = rec.snapshot_to(str(tmp_path / "flight2.json"))
+    loaded = json.load(open(path))
+    assert "boom" in loaded["events"][-1]["data"]["err"]
+
+
+# -- continuous batcher request timelines -----------------------------------
+
+
+def test_batcher_slo_histograms_on_metrics(clean_obs):
+    """Acceptance: after a ContinuousBatcher run, TTFT / inter-token
+    latency / queue-wait histograms are on /metrics with counts that
+    match the completed requests."""
+    from adapt_tpu.models.transformer_lm import lm_tiny
+    from adapt_tpu.runtime.continuous import ContinuousBatcher
+    from adapt_tpu.utils.exporter import serve_metrics
+
+    global_metrics().reset()
+    recorder = global_flight_recorder()
+    recorder.clear()
+    lm = lm_tiny(vocab=29, max_len=64)
+    variables = lm.graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )
+    bat = ContinuousBatcher(lm, variables, slots=4, chunk=4)
+    assert bat.obs_timeline  # default ON — leave-on instrumentation
+    n_req, steps = 3, 5
+    rng = np.random.RandomState(0)
+    ids = [bat.submit(rng.randint(0, 29, size=4), steps) for _ in range(n_req)]
+    done = bat.run()
+    assert sorted(done) == sorted(ids)
+
+    snap = global_metrics().snapshot()
+    hists = snap["histograms"]
+    assert hists["continuous.ttft_s"]["count"] == n_req
+    assert hists["continuous.queue_wait_s"]["count"] == n_req
+    # Every token after a request's first is one inter-token gap.
+    assert hists["continuous.itl_s"]["count"] == n_req * (steps - 1)
+    assert hists["continuous.request_latency_s"]["count"] == n_req
+    assert snap["counters"]["continuous.completed"] == n_req
+    # TTFT <= full latency, pairwise distributions are sane.
+    assert hists["continuous.ttft_s"]["max"] <= (
+        hists["continuous.request_latency_s"]["max"]
+    )
+
+    # Lifecycle events landed in the flight recorder.
+    admits = recorder.events("admit")
+    finishes = recorder.events("finish")
+    assert len(admits) == n_req and len(finishes) == n_req
+    assert {e["data"]["request"] for e in admits} == set(ids)
+    assert all(e["data"]["reason"] == "completed" for e in finishes)
+    assert all(e["data"]["tokens"] == steps for e in finishes)
+
+    # And the whole thing scrapes: histograms + the PR-1 staging gauge.
+    server = serve_metrics(port=0)
+    try:
+        text, _ = _get(server.server_address[1], "/metrics")
+        assert f"adapt_continuous_ttft_s_count {n_req}" in text
+        assert f"adapt_continuous_itl_s_count {n_req * (steps - 1)}" in text
+        assert f"adapt_continuous_queue_wait_s_count {n_req}" in text
+        # Satellite 4 bridges: fused-staging transfer count and the
+        # codec framing-copy counters ride as gauges.
+        assert "adapt_continuous_h2d_transfers" in text
+        assert "adapt_codec_copy_bytes" in text
+        assert "adapt_codec_copy_calls" in text
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_batcher_timeline_off_is_silent(clean_obs):
+    from adapt_tpu.models.transformer_lm import lm_tiny
+    from adapt_tpu.runtime.continuous import ContinuousBatcher
+
+    global_metrics().reset()
+    lm = lm_tiny(vocab=29, max_len=64)
+    variables = lm.graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )
+    bat = ContinuousBatcher(lm, variables, slots=2, chunk=4)
+    bat.obs_timeline = False  # the one-branch off switch
+    bat.submit(np.array([1, 2, 3], np.int32), 4)
+    bat.run()
+    hists = global_metrics().snapshot()["histograms"]
+    for name in (
+        "continuous.ttft_s",
+        "continuous.itl_s",
+        "continuous.queue_wait_s",
+        "continuous.request_latency_s",
+    ):
+        assert name not in hists
+
+
+# -- cross-process span stitching -------------------------------------------
+
+
+def test_remote_spans_stitch_into_single_trace(clean_obs, devices):
+    """Acceptance: a two-stage pipeline run with a REAL remote worker
+    process produces ONE stitched trace — spans recorded in the worker
+    process ride back on the result frames (flags-byte annex), share the
+    request's id with the dispatcher-side spans, and GET /trace.json is
+    structurally Perfetto-loadable with both processes present."""
+    from adapt_tpu.comm.remote import RemoteWorkerProxy
+    from adapt_tpu.config import FaultConfig, ObservabilityConfig, ServeConfig
+    from adapt_tpu.control.dispatcher import Dispatcher
+    from adapt_tpu.graph import partition
+    from adapt_tpu.models.vit import vit_tiny
+    from adapt_tpu.utils.exporter import serve_metrics
+
+    tracer, _ = clean_obs
+    tracer.clear()
+
+    g = vit_tiny()
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = g.init(jax.random.PRNGKey(0), x)
+    plan = partition(g, ["encoder_block_1"])  # 2 stages
+    assert plan.num_stages == 2
+
+    port = 17661
+    os.environ["ADAPT_TPU_TRACE"] = "1"  # worker process enables tracing
+    try:
+        proc = spawn_worker_proc("--port", str(port), "--heartbeat", "0.2")
+    finally:
+        del os.environ["ADAPT_TPU_TRACE"]
+    cfg = ServeConfig(
+        fault=FaultConfig(
+            lease_ttl_s=2.0,
+            heartbeat_s=0.2,
+            task_deadline_s=30.0,
+            watchdog_period_s=0.2,
+            startup_wait_s=15.0,
+            configure_timeout_s=60.0,
+        ),
+        obs=ObservabilityConfig(trace_enabled=True),
+    )
+    disp = Dispatcher(plan, variables, config=cfg)  # enables the tracer
+    assert tracer.enabled
+    disp.spawn_workers(devices[:1])  # stage 0 lives in-process
+    proxy = RemoteWorkerProxy(
+        "obs-remote-0",
+        ("127.0.0.1", port),
+        disp.registry,
+        disp.result_queue,
+        model_config={
+            "model": "vit_tiny",
+            "num_classes": 10,
+            "cuts": ["encoder_block_1"],
+            "input_shape": [2, 32, 32, 3],
+        },
+        fault=cfg.fault,
+    )
+    disp.attach_worker(proxy)
+    disp.start()
+    server = serve_metrics(port=0)
+    try:
+        proxy.start()
+        # The remote owns stage 1 (only configured candidate for it).
+        proxy.configure(1, None, plan.extract_variables(variables)[1])
+        fut = disp.submit(x)
+        y = fut.result(timeout=60.0)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(g.apply(variables, x)), rtol=1e-5
+        )
+        rid = fut.request_id
+
+        body, _ = _get(server.server_address[1], "/trace.json")
+        doc = json.loads(body)
+        events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert events
+        for ev in events:
+            assert {"ph", "ts", "pid", "tid", "name", "dur"} <= set(ev)
+        mine = [e for e in events if e["args"].get("request") == rid]
+        names = {e["name"] for e in mine}
+        # Dispatcher-side spans and the worker-process span, one trace.
+        assert "dispatch.stage_rtt" in names
+        assert "remote.stage_exec" in names
+        assert "request" in names
+        remote_execs = [e for e in mine if e["name"] == "remote.stage_exec"]
+        assert any(e["args"]["stage"] == 1 for e in remote_execs)
+        pids = {e["pid"] for e in mine}
+        assert os.getpid() in pids
+        assert len(pids) >= 2, (
+            f"expected spans from both processes, got pids {pids}"
+        )
+        # attempt tags survive the wire.
+        assert all(
+            e["args"].get("attempt") == 0 for e in remote_execs
+        )
+    finally:
+        server.shutdown()
+        server.server_close()
+        disp.shutdown()
+        proc.terminate()
+        proc.wait(timeout=10)
