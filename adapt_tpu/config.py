@@ -108,6 +108,33 @@ class PipelineConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SpeculativeConfig:
+    """Batched speculative decoding knobs (``runtime/continuous``
+    speculative mode; ``docs/SERVING.md`` §5).
+
+    Speculation trades DRAFT compute for target-model weight streams:
+    every serving tick runs a fixed-shape ``draft_k + 1``-step draft
+    scan over all slots plus ONE fused verify pass, and each slot
+    commits its longest agreeing prefix plus the target's own
+    correction token — between 1 and ``draft_k + 1`` tokens per tick
+    per slot, always exactly the target's greedy stream. The batcher
+    activates this mode when constructed with a draft model
+    (``ContinuousBatcher(..., draft_lm=, draft_variables=,
+    speculative=SpeculativeConfig(...))``).
+    """
+
+    #: Proposals per round. Tokens-per-target-weight-stream tops out at
+    #: ``draft_k + 1`` (perfect acceptance) and degrades toward 1 as the
+    #: draft misses; past ~4-8 the marginal proposal is usually rejected
+    #: (acceptance compounds per position).
+    draft_k: int = 4
+
+    def __post_init__(self):
+        if self.draft_k < 1:
+            raise ValueError(f"draft_k must be >= 1, got {self.draft_k}")
+
+
+@dataclasses.dataclass(frozen=True)
 class ObservabilityConfig:
     """Tracing + flight-recorder knobs (``utils.tracing``, served by
     ``utils.exporter``). The flight recorder is ALWAYS on (bounded ring,
@@ -159,4 +186,7 @@ class ServeConfig:
     )
     obs: ObservabilityConfig = dataclasses.field(
         default_factory=ObservabilityConfig
+    )
+    spec: SpeculativeConfig = dataclasses.field(
+        default_factory=SpeculativeConfig
     )

@@ -15,8 +15,13 @@ token-for-token equality with ``generate()``, from a perfect draft
 token per round, still correct).
 
 TPU shape discipline: the per-round programs are two fixed-shape jits —
-a ``draft_k + 1``-step draft scan and a ``draft_k + 1``-token verify
-chunk — so rounds never recompile regardless of acceptance. Rejected
+a ``draft_k + 1``-step draft scan (:func:`draft_chunk`) and a
+``draft_k + 1``-token verify-and-accept chunk — so rounds never
+recompile regardless of acceptance. Both are BATCH-SHAPED: ``index``
+may be a (b,) vector, each row drafting/verifying at its own position,
+which is what lets the continuous batcher
+(``runtime/continuous.ContinuousBatcher`` speculative mode) run them
+over desynchronized slots as the same two programs. Rejected
 speculation needs NO rollback on either cache: cache entries past the
 accepted position are simply never admitted by the position masks and
 get overwritten by later rounds (the same discipline the continuous
@@ -24,11 +29,18 @@ batcher's trash slot and the SPMD ring's bubble ticks use). Caches are
 allocated with ``draft_k + 1`` slack positions so overshoot writes land
 in masked space.
 
-v1 scope: greedy (temperature 0 — where losslessness is exact equality),
-batch size 1 (per-row acceptance desynchronizes rows; batch speculation
-composes with the continuous batcher later), native-dtype caches. No
-reference analog (CNN-only); this is the serving-latency frontier for
-the repo's flagship LM workload.
+Host-transfer discipline (the serving-control-path cost): acceptance is
+computed ON DEVICE — the round's longest-agreeing-prefix reduction and
+the emitted tokens come back as ONE packed ``(draft_k + 2,)`` fetch per
+round (``stats()["host_fetches"]`` counts them; the test suite pins
+``rounds + 1``), and the loop re-uploads NOTHING (the next round's
+carry token and position stay device-resident). The old loop fetched
+the proposals, re-uploaded them into the verify chunk, then fetched the
+predictions — three transfers and two syncs per round.
+
+v1 scope: greedy (temperature 0 — where losslessness is exact
+equality), native-dtype caches. Single-request here; the batched
+composition lives in the continuous batcher's speculative mode.
 
 Numerics fine print: "exact equality" assumes the chunked verify and the
 sequential decode produce bitwise-equal logits. They run the same ops in
@@ -75,17 +87,31 @@ def _prefill(lm: TransformerLM, variables, prompt, *, cache_len: int):
     return jnp.argmax(logits, axis=-1).astype(prompt.dtype), caches
 
 
-@partial(jax.jit, static_argnames=("lm", "n"))
-def _draft_chunk(lm: TransformerLM, variables, tok, index, caches, *, n):
-    """``n`` greedy decode steps of the draft model: consumes ``tok`` at
-    ``index``, returns its next-token chain (n, b) and updated caches."""
+@partial(jax.jit, static_argnames=("lm", "n"), donate_argnums=(4,))
+def draft_chunk(lm: TransformerLM, variables, tok, index, caches, *, n):
+    """``n`` greedy decode steps of the draft model: consumes ``tok``
+    ((b,)) at ``index``, returns its next-token chain (n, b) and updated
+    caches (donated — the round loop owns them).
+
+    ``index`` is scalar (single-request, every row at one position) or
+    (b,) (batched speculation: each slot drafts from its OWN position —
+    negative rows are dead slots whose writes clamp into their own
+    row's masked space). One compiled program either way; the
+    continuous batcher's speculative tick calls this exact jit."""
     embed, blocks, head = _modules(lm)
+    per_row = bool(jnp.ndim(index))
 
     def step(carry, _):
         tok, index, caches = carry
-        x = embed.apply(
-            variables["embed"], tok[:, None], index, method="embed_at"
-        )
+        if per_row:
+            x = embed.apply(
+                variables["embed"], tok[:, None], index[:, None],
+                method="embed_positions",
+            )
+        else:
+            x = embed.apply(
+                variables["embed"], tok[:, None], index, method="embed_at"
+            )
         new_caches = []
         for name, block, (ck, cv) in zip(lm.block_names, blocks, caches):
             x, ck, cv = block.apply(
@@ -102,16 +128,39 @@ def _draft_chunk(lm: TransformerLM, variables, tok, index, caches, *, n):
     return toks, list(caches)
 
 
-@partial(jax.jit, static_argnames=("lm",))
-def _verify_chunk(lm: TransformerLM, variables, tokens, index, caches):
-    """One cached forward over a (b, K) token chunk starting at
-    ``index``; returns the big model's greedy prediction AFTER each
-    chunk position ((b, K)) and updated caches."""
+def accept_speculation(props, preds):
+    """Per-row longest-agreeing-prefix acceptance, on device: ``props``
+    (b, d) draft proposals, ``preds`` (b, d+1) target greedy
+    predictions after each chunk position. Returns (b,) accepted
+    counts ``a`` — the round commits ``preds[:, :a+1]`` (the agreeing
+    prefix IS the target's own predictions, plus its correction token),
+    which is why greedy speculation is lossless."""
+    d = props.shape[1]
+    agree = jnp.cumprod(
+        (preds[:, :d] == props).astype(jnp.int32), axis=1
+    )
+    return jnp.sum(agree, axis=1)
+
+
+@partial(jax.jit, static_argnames=("lm", "d"), donate_argnums=(5,))
+def _verify_accept(lm: TransformerLM, variables, t0, dtoks, index, caches,
+                   *, d):
+    """One verify-and-accept round for the single-request loop: build
+    the (1, d+1) chunk ``[t0, proposals]`` ON DEVICE from the draft
+    scan's output (no host round-trip), run ``verify_chunk``, reduce
+    the agreeing prefix, and return ONE packed (d+2,) int32 vector
+    ``[a, preds_0..preds_d]`` (the round's single D2H) plus the next
+    round's device-resident carry (next token, next index) and
+    caches."""
     embed, blocks, head = _modules(lm)
-    kc = tokens.shape[1]
+    props = jnp.swapaxes(dtoks[:d], 0, 1)  # (1, d)
+    chunk = jnp.concatenate(
+        [t0[:, None], props.astype(t0.dtype)], axis=1
+    )  # (1, d+1)
+    kc = d + 1
     pos = index + jnp.arange(kc)[None, :]
     x = embed.apply(
-        variables["embed"], tokens, pos, method="embed_positions"
+        variables["embed"], chunk, pos, method="embed_positions"
     )
     new_caches = []
     for name, block, (ck, cv) in zip(lm.block_names, blocks, caches):
@@ -119,8 +168,14 @@ def _verify_chunk(lm: TransformerLM, variables, tokens, index, caches):
             variables[name], x, ck, cv, index, method="verify_chunk"
         )
         new_caches.append((ck, cv))
-    logits = head.apply(variables["head"], x)  # (b, K, V)
-    return jnp.argmax(logits, axis=-1).astype(tokens.dtype), new_caches
+    logits = head.apply(variables["head"], x)  # (1, d+1, V)
+    preds = jnp.argmax(logits, axis=-1).astype(t0.dtype)  # (1, d+1)
+    a = accept_speculation(props, preds)  # (1,)
+    packed = jnp.concatenate(
+        [a.astype(jnp.int32), preds[0].astype(jnp.int32)]
+    )  # (d+2,)
+    nxt = jnp.take_along_axis(preds, a[:, None], axis=1)[:, 0]  # (1,)
+    return packed, nxt, index + a[0] + 1, new_caches
 
 
 def speculative_generate(
@@ -141,14 +196,18 @@ def speculative_generate(
     prompt: (1, s0) int32 ids. ``draft_lm``/``draft_variables`` must
     share the vocab; its quality only affects speed (the per-round
     acceptance), never the output. With ``return_stats`` the emitted
-    array comes with {"rounds", "drafted", "accepted", "acceptance"}.
+    array comes with {"rounds", "drafted", "accepted", "acceptance",
+    "host_fetches"} — ``host_fetches`` counts every device->host
+    transfer the loop performed (one packed vector per round plus the
+    prefill token; the tests pin it at ``rounds + 1``).
     """
     prompt = jnp.asarray(prompt)
     b, s0 = prompt.shape
     if b != 1:
         raise ValueError(
             f"speculative_generate is single-request (b=1), got b={b}; "
-            "batch speculation desynchronizes rows per-round"
+            "batched speculation lives in the continuous batcher "
+            "(ContinuousBatcher(draft_lm=...))"
         )
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
@@ -178,35 +237,34 @@ def speculative_generate(
         draft_lm, draft_variables, prompt, cache_len=draft_cache_len
     )
 
+    fetches = 1  # the prefill token below
     emitted = [int(first[0])]
-    index = s0  # both models: position where the NEXT consumed token lands
+    # Device-resident round carry: the last emitted token and the
+    # position where the next consumed token lands, for BOTH models —
+    # the loop stages nothing back to the device between rounds.
+    tok_dev = first  # (1,)
+    # One position cursor serves both models: their caches cover the
+    # same committed stream.
+    index_dev = jnp.asarray(s0, jnp.int32)
     rounds = drafted = accepted = 0
     while len(emitted) < steps:
-        t0 = jnp.asarray([emitted[-1]], prompt.dtype)
         # Draft d proposals (plus one throwaway step so the draft's own
         # cache covers every token the next round may start after).
-        dtoks, dcaches = _draft_chunk(
-            draft_lm, draft_variables, t0, jnp.asarray(index, jnp.int32),
-            dcaches, n=d + 1,
+        dtoks, dcaches = draft_chunk(
+            draft_lm, draft_variables, tok_dev, index_dev, dcaches,
+            n=d + 1,
         )
-        props = np.asarray(dtoks)[:d, 0]  # d proposals
-        chunk = jnp.concatenate(
-            [t0[:, None], jnp.asarray(props, prompt.dtype)[None, :]], axis=1
-        )  # (1, d+1): [t0, d1..dd]
-        preds, caches = _verify_chunk(
-            lm, variables, chunk, jnp.asarray(index, jnp.int32), caches
+        packed, tok_dev, index_dev, caches = _verify_accept(
+            lm, variables, tok_dev, dtoks, index_dev, caches, d=d
         )
-        preds = np.asarray(preds)[0]  # preds[i] = greedy after chunk[i]
-        # Longest agreeing prefix: preds[i-1] == d_i.
-        a = 0
-        while a < d and preds[a] == props[a]:
-            a += 1
-        new = [int(t) for t in props[:a]] + [int(preds[a])]
+        packed = np.asarray(packed)  # THE round's one device->host sync
+        fetches += 1
+        a = int(packed[0])
+        new = [int(t) for t in packed[1: a + 2]]  # preds[:a+1]
         rounds += 1
         drafted += d
         accepted += a
         emitted.extend(new)
-        index += a + 1
         if eos_id is not None and eos_id in new:
             break  # finished; the tail below pads with EOS
     emitted = emitted[:steps]
@@ -224,5 +282,6 @@ def speculative_generate(
             "drafted": drafted,
             "accepted": accepted,
             "acceptance": accepted / drafted if drafted else 0.0,
+            "host_fetches": fetches,
         }
     return out

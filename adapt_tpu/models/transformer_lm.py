@@ -34,10 +34,15 @@ from jax import lax
 
 from adapt_tpu.graph.ir import INPUT, LayerGraph
 from adapt_tpu.ops.attention import flash_attention
-from adapt_tpu.ops.decode_attention import decode_attention
+from adapt_tpu.ops.decode_attention import (
+    append_kv,
+    decode_attention,
+    verify_attention,
+)
 from adapt_tpu.ops.paged_attention import (
     paged_attention,
     paged_chunk_attention,
+    paged_verify_attention,
 )
 from adapt_tpu.models.moe import MoEDecoderMlp
 from adapt_tpu.ops.quantize import quantize_kv_vectors
@@ -282,19 +287,12 @@ class CausalSelfAttention(nn.Module):
             )
         return out, jnp.pad(k, pad), jnp.pad(v, pad)
 
-    @staticmethod
-    def _cache_write(cache, new, index):
-        """Write one token's K or V at ``index``: a scalar index updates
-        the whole batch at one position (the generate() lockstep), a
-        (b,) index writes each ROW at its own position — what continuous
-        batching needs, where every slot is at a different sequence
-        length. The per-row form is a vmapped dynamic_update_slice (one
-        fused scatter under XLA, not b copies)."""
-        if jnp.ndim(index):
-            return jax.vmap(
-                lambda c, n, i: lax.dynamic_update_slice(c, n, (0, i, 0))
-            )(cache, new, index)
-        return lax.dynamic_update_slice(cache, new, (0, 0, index, 0))
+    # Write K tokens' K or V at ``index`` (scalar: whole batch at one
+    # position, the generate() lockstep; (b,): each ROW at its own
+    # position — continuous batching and batched speculation, where
+    # every slot is at a different sequence length). One definition in
+    # ``ops/decode_attention.append_kv`` shared with the verify paths.
+    _cache_write = staticmethod(append_kv)
 
     def decode_step(
         self, x_t, cache_k, cache_v, index, valid_from=None, quantized=False,
@@ -433,41 +431,75 @@ class CausalSelfAttention(nn.Module):
         verify primitive: each chunk row's query attends the cache up to
         its own position (``p <= index + row``), so the K logits equal
         exactly what K sequential ``decode_step`` calls would produce,
-        for one forward instead of K. The chunk K/V write is a single
-        contiguous ``dynamic_update_slice``; rejected suffixes need no
-        rollback — the position mask simply never admits them (the same
-        trash-slot discipline the continuous batcher uses)."""
+        for one forward instead of K. ``index`` is scalar (the
+        single-request speculative loop) or (b,) (BATCHED speculation:
+        every slot verifies its own chunk at its own position — rows
+        desynchronize, the compiled program does not; a negative row
+        index marks a dead slot whose writes and reads are trash-masked).
+        The chunk K/V write is one ``append_kv`` scatter; rejected
+        suffixes need no rollback — the position mask simply never
+        admits them (the same trash-slot discipline the continuous
+        batcher uses)."""
         b, kc, d = x.shape
         q, k, v = self._project(x)  # q (b, h, K, hd); k/v (b, kv_h, K, hd)
-        q, k = self._rope_qk(q, k, index + jnp.arange(kc))
+        if jnp.ndim(index):
+            pos = index[:, None] + jnp.arange(kc)[None, :]  # (b, K)
+        else:
+            pos = index + jnp.arange(kc)
+        q, k = self._rope_qk(q, k, pos)
         q = self._group_q(q)  # (b, kv_h, g*K, hd), row = member*K + pos
-        sm = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
-        cache_k = lax.dynamic_update_slice(cache_k, k, (0, 0, index, 0))
-        cache_v = lax.dynamic_update_slice(cache_v, v, (0, 0, index, 0))
-        s = (
-            jnp.einsum(
-                "bhqd,bhkd->bhqk",
-                q.astype(jnp.float32),
-                cache_k.astype(jnp.float32),
-            )
-            * sm
-        )  # (b, kv_h, g*K, cache_len)
-        positions = jnp.arange(cache_k.shape[2])
-        rows = jnp.arange(kc)
-        live = positions[None, :] <= (index + rows)[:, None]  # (K, L)
-        if self.window is not None:
-            live = live & (
-                positions[None, :] > (index + rows)[:, None] - self.window
-            )
-        live = jnp.tile(live, (self._group, 1))  # (g*K, L), K-major per member
-        s = jnp.where(live[None, None], s, _NEG_INF)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum(
-            "bhqk,bhkd->bhqd", p, cache_v.astype(jnp.float32)
+        cache_k = append_kv(cache_k, k, index)
+        cache_v = append_kv(cache_v, v, index)
+        o = verify_attention(
+            q, cache_k, cache_v, index, kc, window=self.window
         ).astype(x.dtype)
         o = self._ungroup_o(o, kc)  # (b, h, K, hd)
         o = jnp.swapaxes(o, 1, 2).reshape(b, kc, self.dim)
         return self.out(o), cache_k, cache_v
+
+    def verify_chunk_paged(
+        self, x, k_pool, v_pool, page_table, index, attn_impl=None,
+    ):
+        """Batched verify over a PAGED cache: scatter each slot's K
+        chunk tokens into its own pages at ``index[b]..index[b]+K-1``
+        (table-mapped, one advanced-index scatter), then attend each
+        row's paged window up to its own diagonal
+        (:func:`paged_verify_attention`) — ``verify_chunk``'s exact
+        semantics over ``decode_step_paged``'s layout. ``index`` (b,);
+        a negative row is dead (idle or mid-chunked-prefill slot): its
+        writes route to the trash page and its positions all mask."""
+        b, kc, _ = x.shape
+        page = k_pool.shape[2]
+        q, k, v = self._project(x)  # q (b, h, K, hd); k/v (b, kv_h, K, hd)
+        idx = jnp.broadcast_to(
+            jnp.asarray(index, jnp.int32).reshape(-1), (b,)
+        )
+        if self.rope:
+            q, k = self._rope_qk(
+                q, k, idx[:, None] + jnp.arange(kc)[None, :]
+            )
+        q = self._group_q(q)  # (b, kv_h, g*K, hd)
+        live_row = idx >= 0
+        pos = jnp.maximum(idx, 0)[:, None] + jnp.arange(kc)[None, :]
+        phys = jnp.take_along_axis(page_table, pos // page, axis=1)
+        phys = jnp.where(live_row[:, None], phys, 0)  # dead -> trash page
+        off = pos % page
+        # Advanced-index scatter: (phys[b,t], :, off[b,t], :) <- token t
+        # of slot b. Dead rows' K writes pile unordered onto the trash
+        # page — never read (their masks are empty).
+        k_pool = k_pool.at[phys, :, off, :].set(
+            jnp.swapaxes(k, 1, 2).astype(k_pool.dtype)
+        )
+        v_pool = v_pool.at[phys, :, off, :].set(
+            jnp.swapaxes(v, 1, 2).astype(v_pool.dtype)
+        )
+        o = paged_verify_attention(
+            q, k_pool, v_pool, page_table, idx, kc, prefer=attn_impl,
+            window=self.window,
+        ).astype(x.dtype)
+        o = self._ungroup_o(o, kc)
+        o = jnp.swapaxes(o, 1, 2).reshape(b, kc, self.dim)
+        return self.out(o), k_pool, v_pool
 
 
 class DecoderBlock(nn.Module):
@@ -574,6 +606,15 @@ class DecoderBlock(nn.Module):
         )
         x = x + a
         return x + self._mlp(self.ln2(x)), ck, cv
+
+    def verify_chunk_paged(
+        self, x, k_pool, v_pool, page_table, index, attn_impl=None,
+    ):
+        a, kp, vp = self.attn.verify_chunk_paged(
+            self.ln1(x), k_pool, v_pool, page_table, index, attn_impl
+        )
+        x = x + a
+        return x + self._mlp(self.ln2(x)), kp, vp
 
 
 class TokenEmbed(nn.Module):

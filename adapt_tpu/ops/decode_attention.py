@@ -51,6 +51,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 
 try:
@@ -278,6 +279,76 @@ def _decode_impl(q, k_vals, v_vals, k_scales, v_scales, index, valid_from,
         interpret=not on_tpu,
     )(*operands)
     return out.reshape(b, kvh, gq, hd)[:, :, :g, :]
+
+
+def append_kv(cache, new, index):
+    """Multi-token-per-slot cache append — THE cached-decode write
+    primitive, shared by single-token ``decode_step`` (K == 1) and the
+    speculative verify paths (K == draft_k + 1).
+
+    cache (b, h, L, hd); new (b, h, K, hd); ``index`` scalar (whole
+    batch writes at one position — the ``generate()`` lockstep and the
+    single-request verify) or (b,) (each ROW writes its K tokens at its
+    own position — batched speculation, where slots desynchronize; a
+    vmapped ``dynamic_update_slice``, one fused scatter under XLA, not
+    b copies). XLA clamps the start index, so callers must reserve
+    K - 1 slack positions past the largest live index (the trash-slack
+    discipline ``runtime/continuous`` and ``models/speculative`` cache
+    allocations follow) — a clamped garbage write lands in masked space
+    instead of silently shifting onto live positions."""
+    if jnp.ndim(index):
+        return jax.vmap(
+            lambda c, n, i: lax.dynamic_update_slice(c, n, (0, i, 0))
+        )(cache, new, index)
+    return lax.dynamic_update_slice(cache, new, (0, 0, index, 0))
+
+
+def verify_attention(q, cache_k, cache_v, index, chunk: int, window=None):
+    """Multi-token VERIFY attention: K chunk rows per slot, each
+    attending the cache up to its OWN position — the speculative-decode
+    primitive (K causal logits for one weight stream).
+
+    q (b, kv_h, g*chunk, hd) group-folded with K-major rows (row =
+    member*chunk + t, ``CausalSelfAttention._group_q`` on a (b, h, K,
+    hd) query); caches (b, kv_h, L, hd) with the chunk's K/V already
+    appended (``append_kv``); ``index`` scalar or (b,) is the cache
+    position of chunk token 0, so row t's live window is
+    ``col <= index + t`` (banded below by ``window`` when set). A
+    negative per-row index marks a DEAD row (idle slot): every position
+    masks out and the output is finite garbage nothing reads — the same
+    discipline as the batcher's trash slot.
+
+    The einsum schedule is ``decode_attention_reference``'s with a
+    per-row diagonal instead of a shared newest position; XLA-only for
+    now (``decode_kernel_wins`` rules the streaming kernel out
+    everywhere until its hardware A/B lands, and verify amortizes the
+    cache stream over K rows already)."""
+    sm = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    s = (
+        jnp.einsum(
+            "bhqd,bhkd->bhqk",
+            q.astype(jnp.float32),
+            cache_k.astype(jnp.float32),
+        )
+        * sm
+    )  # (b, kv_h, g*chunk, L)
+    cols = jnp.arange(cache_k.shape[2])
+    rows = jnp.arange(q.shape[2]) % chunk  # row -> chunk position t
+    if jnp.ndim(index):
+        edge = index[:, None, None] + rows[None, :, None]  # (b, g*K, 1)
+        live = cols[None, None, :] <= edge
+        if window is not None:
+            live = live & (cols[None, None, :] > edge - window)
+        s = jnp.where(live[:, None], s, _NEG_INF)
+    else:
+        edge = index + rows[:, None]  # (g*K, 1)
+        live = cols[None, :] <= edge
+        if window is not None:
+            live = live & (cols[None, :] > edge - window)
+        s = jnp.where(live[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, cache_v.astype(jnp.float32))
+    return o.astype(q.dtype)
 
 
 def decode_attention_reference(q, cache_k, cache_v, index, valid_from=None):

@@ -388,6 +388,200 @@ def paged_chunk_attention(
     )
 
 
+def paged_verify_attention_reference(q, k_pool, v_pool, page_table, index,
+                                     chunk: int, window: int | None = None):
+    """jnp oracle for the batched paged VERIFY: gather each slot's pages
+    into a contiguous window and run the contiguous verify oracle
+    (``ops/decode_attention.verify_attention``) — per-row diagonal
+    ``col <= index[b] + row % chunk``. q (b, kv_h, g*chunk, hd)
+    group-folded K-major; ``index`` (b,) per-slot base positions
+    (negative = dead row, fully masked)."""
+    from adapt_tpu.ops.decode_attention import verify_attention
+
+    b = q.shape[0]
+
+    def gather(pool):
+        g_ = pool[page_table]  # (b, pages, kvh, P, hd)
+        g_ = jnp.moveaxis(g_, 2, 1)
+        return g_.reshape(b, pool.shape[1], -1, pool.shape[3])
+
+    return verify_attention(
+        q, gather(k_pool), gather(v_pool), index, chunk, window=window
+    )
+
+
+def _verify_kernel(table_ref, q_ref, k_ref, v_ref, idx_ref, *refs,
+                   block_k, num_kv, sm_scale, chunk, window=None):
+    """Batched chunk-query paged attention: one (batch, kv_head) row of
+    K-major verify rows streams ITS page-table row innermost (scalar
+    prefetch, as ``_paged_kernel``) with ``_chunk_kernel``'s per-row
+    diagonal mask anchored at this slot's OWN base position
+    (``idx_ref`` SMEM) — the speculative verify over a paged cache.
+    Dead rows (negative index) skip every block and emit zeros."""
+    del table_ref  # consumed by the index_maps
+    o_ref, m_scr, l_scr, acc_scr = refs
+    j = pl.program_id(1)
+    gc = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, -1e30, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32)  # (gc, hd)
+        k = k_ref[0, 0].astype(jnp.float32)  # (block_k, hd)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * sm_scale
+        )  # (gc, block_k)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (gc, block_k), 0) % chunk
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (gc, block_k), 1
+        )
+        live = cols <= idx_ref[0] + rows
+        if window is not None:
+            live = jnp.logical_and(live, cols > idx_ref[0] + rows - window)
+        s = jnp.where(live, s, -1e30)
+        m = m_scr[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    # Pages wholly past this slot's last chunk position are dead (every
+    # page, for a negative dead-row index); under a sliding window so
+    # are pages wholly below row 0's window.
+    live_block = j * block_k <= idx_ref[0] + chunk - 1
+    if window is not None:
+        live_block = jnp.logical_and(
+            live_block, (j + 1) * block_k - 1 > idx_ref[0] - window
+        )
+    pl.when(live_block)(_step)
+
+    @pl.when(j == num_kv - 1)
+    def _emit():
+        o_ref[0] = (
+            acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "window"))
+def _verify_impl(q, k_pool, v_pool, page_table, index, chunk, window=None):
+    b, kvh, gc, hd = q.shape
+    page = k_pool.shape[2]
+    pages_per_slot = page_table.shape[1]
+    pad_g = (-gc) % 8
+    if pad_g:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_g), (0, 0)))
+    gcp = gc + pad_g
+    qf = q.reshape(b * kvh, gcp, hd)
+    idx = jnp.repeat(
+        jnp.broadcast_to(jnp.asarray(index, jnp.int32).reshape(-1), (b,)),
+        kvh,
+    )
+
+    def q_map(bh, j, table_ref):
+        del j, table_ref
+        return (bh, 0, 0)
+
+    def kv_map(bh, j, table_ref):
+        return (table_ref[bh // kvh, j], bh % kvh, 0, 0)
+
+    def smem_map(bh, j, table_ref):
+        del j, table_ref
+        return (bh,)
+
+    on_tpu = jax.default_backend() == "tpu"
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * kvh, pages_per_slot),
+        in_specs=[
+            pl.BlockSpec((1, gcp, hd), q_map, memory_space=_VMEM),
+            pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
+            pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
+            pl.BlockSpec((1,), smem_map, memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, gcp, hd), q_map, memory_space=_VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((gcp, 1), jnp.float32),
+            pltpu.VMEM((gcp, 1), jnp.float32),
+            pltpu.VMEM((gcp, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _verify_kernel,
+            block_k=page,
+            num_kv=pages_per_slot,
+            sm_scale=1.0 / (hd ** 0.5),
+            chunk=chunk,
+            window=window,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * kvh, gcp, hd), q.dtype),
+        compiler_params=(
+            pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")
+            )
+            if on_tpu
+            else None
+        ),
+        interpret=not on_tpu,
+    )(jnp.asarray(page_table, jnp.int32), qf, k_pool, v_pool, idx)
+    return out.reshape(b, kvh, gcp, hd)[:, :, :gc, :]
+
+
+def paged_verify_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    index,
+    chunk: int,
+    prefer: str | None = None,
+    window: int | None = None,
+) -> jax.Array:
+    """Batched multi-token verify attention over a paged KV cache — the
+    speculative-decode counterpart of :func:`paged_attention` (K chunk
+    rows per slot, each masked to its own ``index[b] + t`` diagonal;
+    the caller has already scattered the chunk's K/V into the pages).
+
+    Dispatch as :func:`paged_attention`: the scalar-prefetch kernel on
+    a real TPU with lane-multiple pages (the gather oracle materializes
+    every slot's whole window — the traffic paging exists to avoid),
+    the oracle everywhere else."""
+    page = k_pool.shape[2]
+    supported = pltpu is not None and page % 128 == 0
+    if prefer is None:
+        prefer = (
+            "pallas"
+            if supported and jax.default_backend() == "tpu"
+            else "xla"
+        )
+    elif prefer not in ("pallas", "xla"):
+        raise ValueError(
+            f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
+        )
+    if prefer == "pallas" and supported:
+        return _verify_impl(
+            q, k_pool, v_pool, page_table, index, chunk, window
+        )
+    return paged_verify_attention_reference(
+        q, k_pool, v_pool, page_table, index, chunk, window
+    )
+
+
 def paged_attention(
     q: jax.Array,
     k_pool: jax.Array,

@@ -103,6 +103,33 @@ disables the histograms; flight-recorder lifecycle events
 (admit/finish/cancel — per-request, not per-token) are always on, and
 spans (prefill, decode chunk) additionally require the global tracer.
 
+**Batched speculative decoding** (``draft_lm=``/``draft_variables=`` +
+``config.SpeculativeConfig``): every serving tick becomes a fixed-shape
+``draft_k + 1``-step draft scan over ALL slots
+(``models/speculative.draft_chunk`` — the same jit the single-request
+loop runs, batch-shaped) followed by ONE fused verify pass
+(``_spec_verify``), then per-slot longest-agreeing-prefix acceptance.
+Rows DESYNCHRONIZE — slot A commits 5 tokens this tick while slot B
+commits 1 — but positions, page tables and cache write masks are all
+per-slot device vectors, so the two compiled programs never change
+shape and nothing recompiles (guarded by a compile-count test).
+Rejected speculation needs no rollback on either cache: each layout
+carries ``draft_k`` SLACK positions (dense strips grow by ``draft_k``,
+paged admissions reserve the slack pages), so overshoot writes land
+past every slot's accepted position and are overwritten by later
+rounds — the same trash-slot/masked-write discipline as the rest of
+this module. Per-row greedy LOSSLESSNESS is the tested contract: each
+request's stream equals its solo ``generate()`` token-for-token
+whatever the draft proposes and however acceptance staggers across
+slots (speculative mode is greedy-only; ``submit`` rejects
+``temperature > 0``). The draft model keeps its own dense slot strips
+(it exists to be small — paging its cache buys capacity that is not
+the bottleneck) and is fully prefilled per admission; EOS/stop/cancel
+latch at acceptance boundaries through the ordinary commit path. The
+steady-state spec tick stages ZERO host arrays and performs ONE fused
+device->host fetch (tokens + logprobs + accepted counts) — the PR-1
+fused-staging contract, extended.
+
 Request lifecycle niceties: ``submit(stop=[[...], ...])`` ends a stream
 at the first emitted occurrence of any stop token-sequence (host-side
 tail check — the emitted prefix still equals solo ``generate()``), and
@@ -129,6 +156,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from adapt_tpu.config import SpeculativeConfig
+from adapt_tpu.models.speculative import accept_speculation, draft_chunk
 from adapt_tpu.models.transformer_lm import (
     TransformerLM,
     chosen_logprob,
@@ -215,6 +244,9 @@ class ContinuousBatcher:
         page_size: int = 128,
         pool_pages: int | None = None,
         prefill_chunk: int | None = None,
+        draft_lm: TransformerLM | None = None,
+        draft_variables=None,
+        speculative: SpeculativeConfig | None = None,
     ):
         self.lm = lm
         self.variables = variables
@@ -223,6 +255,38 @@ class ContinuousBatcher:
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         self.chunk = chunk
+        if speculative is not None and draft_lm is None:
+            raise ValueError(
+                "speculative config requires draft_lm/draft_variables"
+            )
+        if draft_lm is not None:
+            if draft_variables is None:
+                raise ValueError("draft_lm requires draft_variables")
+            if draft_lm.vocab != lm.vocab:
+                raise ValueError(
+                    f"draft vocab {draft_lm.vocab} != target vocab "
+                    f"{lm.vocab}"
+                )
+            if draft_lm.max_len < lm.max_len:
+                # The draft prefills the same prompt buckets and decodes
+                # the same positions as the target; a shorter draft
+                # context would silently truncate them.
+                raise ValueError(
+                    f"draft max_len {draft_lm.max_len} < target max_len "
+                    f"{lm.max_len}"
+                )
+            if kv_cache_dtype != "native":
+                raise ValueError(
+                    "speculative mode requires kv_cache_dtype='native' "
+                    "(the verify chunk appends native K/V; int8 verify "
+                    "is future work)"
+                )
+            self._spec = speculative or SpeculativeConfig()
+        else:
+            self._spec = None
+        self._spec_k = self._spec.draft_k if self._spec else 0
+        self._draft_lm = draft_lm
+        self._draft_variables = draft_variables
         if kv_cache_dtype not in ("native", "int8"):
             raise ValueError(
                 f"kv_cache_dtype={kv_cache_dtype!r}: expected 'native' "
@@ -275,7 +339,12 @@ class ContinuousBatcher:
         #: Sliding-window models: decode masking lives in the model;
         #: the batcher's job is page RECYCLING behind the window.
         self._window = getattr(block0, "window", None)
-        self._cache_len = lm.max_len + 1  # one trash slot for idle rows
+        # One trash slot for idle rows, plus draft_k SLACK positions in
+        # speculative mode: a verify chunk writes draft_k + 1 tokens
+        # from each slot's position (trash included), and the rejected
+        # overshoot must land in masked space, never shift onto live
+        # rows (append_kv clamps).
+        self._cache_len = lm.max_len + 1 + self._spec_k
         self._trash = lm.max_len
         # Slot caches hold KV heads: fewer than query heads under GQA
         # (the whole point — slots cost kv_heads/heads the HBM).
@@ -285,7 +354,9 @@ class ContinuousBatcher:
             if page_size < 1:
                 raise ValueError(f"page_size must be >= 1, got {page_size}")
             self._page = page_size
-            pps = -(-lm.max_len // page_size)  # ceil: table width
+            # Table width covers max_len plus the speculative overshoot
+            # slack (verify writes reach position + draft_k).
+            pps = -(-(lm.max_len + self._spec_k) // page_size)
             worst = slots * pps + 1  # every slot full + trash page
             if pool_pages is None:
                 pool_pages = worst
@@ -322,9 +393,40 @@ class ContinuousBatcher:
         self._caches = [(one_cache(), one_cache()) for _ in lm.block_names]
         #: Idle-row cache position: slot layout parks garbage writes at
         #: the trash strip; paged layout uses a negative sentinel that
-        #: stays negative across a whole chunk's pos+1 increments
-        #: (-(C+1) .. -2), routing every garbage write to the trash page.
-        self._idle_pos = -(self.chunk + 1) if self._paged else self._trash
+        #: stays negative across a whole tick's position advance
+        #: (chunk steps, or the spec tick's up-to-draft_k+1 commit),
+        #: routing every garbage write to the trash page.
+        adv = (self._spec_k + 1) if self._spec else self.chunk
+        self._idle_pos = -(adv + 1) if self._paged else self._trash
+        #: Draft-model slot caches (speculative mode): dense per-slot
+        #: strips with the same draft_k + 1 slack as the single-request
+        #: loop — the draft is small by construction, so slots x max_len
+        #: dense strips cost what paging would save on the big model.
+        if self._spec:
+            dblock = draft_lm.graph.node(draft_lm.block_names[0]).module
+            self._draft_blocks = [
+                draft_lm.graph.node(n).module
+                for n in draft_lm.block_names
+            ]
+            self._draft_embed = draft_lm.graph.node("embed").module
+            dclen = draft_lm.max_len + self._spec_k + 1
+
+            def draft_cache():
+                return jnp.zeros(
+                    (slots, dblock.cache_heads, dclen, dblock.head_dim),
+                    dblock.dtype,
+                )
+
+            self._draft_caches = [
+                (draft_cache(), draft_cache())
+                for _ in draft_lm.block_names
+            ]
+        else:
+            self._draft_caches = None
+        #: Speculation lifetime counters (instance-scoped, like the
+        #: admit/complete counts): drafted proposals vs accepted ones.
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         #: Host->device staging transfers (every jnp.asarray/device_put
         #: this module issues goes through _h2d). The fused-staging
         #: contract: ZERO on a steady-state decode tick, O(1) per
@@ -568,6 +670,79 @@ class ContinuousBatcher:
         new["kbase"] = jnp.where(active, kbase + C, 0)
         return toks, lps, list(caches), new
 
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
+    def _spec_verify(self, variables, caches, dstate, dtoks, table=None):
+        """The speculative tick's VERIFY program — the second of its
+        exactly two compiled programs (the first is the shared
+        ``models/speculative.draft_chunk`` scan).
+
+        Builds every slot's (draft_k + 1) chunk ``[last_token,
+        proposals]`` ON DEVICE from the draft scan's output, runs one
+        fused ``verify_chunk`` / ``verify_chunk_paged`` pass over all
+        slots at their own positions (rows desynchronize; the program
+        does not), reduces each row's longest agreeing prefix
+        (``accept_speculation``), and advances the donated device state
+        by each row's commit count — so the steady-state spec tick
+        stages zero host arrays and the caller performs ONE fused
+        device->host fetch of (tokens, logprobs, accepted). Inactive
+        rows re-park at the idle sentinel; their writes are
+        trash-routed by the verify primitives. Returns ((d+1, B)
+        tokens, (d+1, B) logprobs, (B,) accepted counts, caches,
+        dstate)."""
+        paged = table is not None
+        d = self._spec_k
+        tok, pos = dstate["tok"], dstate["pos"]
+        active = dstate["active"]
+        props = jnp.swapaxes(dtoks[:d], 0, 1)  # (B, d)
+        chunk = jnp.concatenate(
+            [tok[:, None], props.astype(tok.dtype)], axis=1
+        )  # (B, d+1)
+        pos_ids = pos[:, None] + jnp.arange(d + 1)[None, :]
+        x = self._embed.apply(
+            variables["embed"], chunk, pos_ids, method="embed_positions"
+        )
+        new_caches = []
+        for name, block, cache in zip(
+            self.lm.block_names, self._blocks, caches
+        ):
+            if paged:
+                kp, vp = cache
+                x, kp, vp = block.apply(
+                    variables[name], x, kp, vp, table, pos, None,
+                    method="verify_chunk_paged",
+                )
+                new_caches.append((kp, vp))
+            else:
+                ck, cv = cache
+                x, ck, cv = block.apply(
+                    variables[name], x, ck, cv, pos,
+                    method="verify_chunk",
+                )
+                new_caches.append((ck, cv))
+        logits = self._head.apply(variables["head"], x)  # (B, d+1, V)
+        preds = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+        lps = chosen_logprob(
+            logits.reshape(-1, logits.shape[-1]), preds.reshape(-1)
+        ).reshape(preds.shape)  # (B, d+1)
+        acc = accept_speculation(props, preds)  # (B,)
+        ncommit = acc + 1
+        last = jnp.take_along_axis(preds, acc[:, None], axis=1)[:, 0]
+        # Optimistic device-side advance, exactly _step_chunk's
+        # discipline: a surviving slot's entry invariants land on
+        # pos + ncommit; retired slots are cleared host-side
+        # (_clear_slot) before the next tick; idle rows re-park.
+        new = dict(dstate)
+        new["pos"] = jnp.where(active, pos + ncommit, self._idle_pos)
+        new["tok"] = jnp.where(active, last, 0)
+        new["kbase"] = jnp.where(active, dstate["kbase"] + ncommit, 0)
+        return (
+            jnp.swapaxes(preds, 0, 1),
+            jnp.swapaxes(lps, 0, 1),
+            acc,
+            new_caches,
+            new,
+        )
+
     def _insert_paged(self, caches, pages, kvs):
         """Scatter a prefilled request's per-block K/V into its pages
         (``runtime/paged.insert_prefill_pages`` per pool)."""
@@ -685,6 +860,49 @@ class ContinuousBatcher:
         self._prefill_cache[key] = prefill
         return prefill
 
+    def _draft_prefill_fn(self, bucket: int):
+        """Jitted DRAFT prefill for one prompt bucket: full causal
+        forward over (1, bucket), per-block K/V to insert into the
+        draft's dense slot strips. No sampling tail — the draft never
+        emits; it only seeds its cache for the per-tick draft scan."""
+        key = ("draft", bucket)
+        if key in self._prefill_cache:
+            return self._prefill_cache[key]
+
+        @jax.jit
+        def dprefill(variables, ids):
+            h = self._draft_embed.apply(variables["embed"], ids)
+            kvs = []
+            for name, block in zip(
+                self._draft_lm.block_names, self._draft_blocks
+            ):
+                h, ck, cv = block.apply(
+                    variables[name], h, bucket, None, False,
+                    method="prefill",
+                )
+                kvs.append((ck, cv))
+            return kvs
+
+        self._prefill_cache[key] = dprefill
+        return dprefill
+
+    def _admit_draft(self, slot_idx: int, req: _Request) -> None:
+        """Prefill the DRAFT model's whole prompt into its dense slot
+        row. Always the full prompt: the draft has no prefix cache and
+        no chunked prefill — it is small by construction, so one
+        bucketed pass per admission is the entire cost of keeping its
+        cache in lockstep with the target's committed stream."""
+        s0 = req.prompt.shape[0]
+        bucket = next(b for b in self.prompt_buckets if b >= s0)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :s0] = req.prompt
+        kvs = self._draft_prefill_fn(bucket)(
+            self._draft_variables, self._h2d(ids)
+        )
+        self._draft_caches = self._insert(
+            self._draft_caches, self._h2d(np.int32(slot_idx)), kvs
+        )
+
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
     def _insert(self, caches, slot, kvs):
         """Write a prefilled request's K/V into slot row ``slot``
@@ -747,9 +965,16 @@ class ContinuousBatcher:
                 f"prompt {s0} exceeds largest bucket "
                 f"{self.prompt_buckets[-1]}"
             )
+        if self._spec and temperature > 0.0:
+            raise ValueError(
+                "speculative mode is greedy-only (v1): greedy is where "
+                "losslessness is exact equality — submit with "
+                "temperature=0, or serve sampled traffic through a "
+                "non-speculative batcher"
+            )
         if self._paged:
             bucket = next(b for b in self.prompt_buckets if b >= s0)
-            need = -(-max(bucket, s0 + steps) // self._page)
+            need = -(-max(bucket, s0 + steps + self._spec_k) // self._page)
             if need > self._pool_pages - 1:  # page 0 is trash
                 # Would queue forever: the pool can never cover it.
                 raise ValueError(
@@ -997,7 +1222,10 @@ class ContinuousBatcher:
                 # s0 + steps - 1). FIFO head-of-line: if the pool can't
                 # cover the next request, admission stops — later
                 # (smaller) requests do not jump it.
-                span = max(bucket, s0 + req.steps)
+                # Speculative mode reserves draft_k SLACK pages: the
+                # verify chunk's rejected overshoot writes land there,
+                # masked, instead of off the end of the window.
+                span = max(bucket, s0 + req.steps + self._spec_k)
                 n_pages = -(-span // P) - m
                 if not self._pager.alloc(i, n_pages):
                     self._pager.free_slot(i)  # releases the shares too
@@ -1126,7 +1354,10 @@ class ContinuousBatcher:
                 self._commit(slot, int(first[0]), float(first_lp[0]))
                 if slot.req is req:
                     # Survived the first commit: stage its whole device
-                    # row in one fused setter call.
+                    # row in one fused setter call (and, speculating,
+                    # seed the draft's cache with the prompt).
+                    if self._spec:
+                        self._admit_draft(slot.idx, req)
                     self._stage_decode_row(slot)
 
     def _stage_decode_row(self, slot: _Slot) -> None:
@@ -1242,15 +1473,103 @@ class ContinuousBatcher:
             slot.pf_done = -1
             self._commit(slot, int(first[0]), float(first_lp[0]))
             if slot.req is req:
+                if self._spec:
+                    self._admit_draft(slot.idx, req)
                 self._stage_decode_row(slot)
+
+    def _spec_decode(self, active, tracer):
+        """One SPECULATIVE decode round for the whole slot batch: the
+        fixed-shape draft scan (``models/speculative.draft_chunk`` over
+        the device-resident per-slot state), then the fused
+        verify-and-accept program (``_spec_verify``). Exactly two
+        compiled programs however rows desynchronize — guarded by the
+        compile-count test. Stages zero host arrays steady-state and
+        fetches the round's (tokens, logprobs, accepted) in ONE host
+        sync. Returns host-side ((d+1, B) tokens, logprobs, (B,)
+        per-slot commit limits)."""
+        d = self._spec_k
+        # Only the span tags consume the id tuple — don't build it on
+        # the untraced hot path.
+        req_ids = (
+            tuple(s.req.req_id for s in active) if tracer.enabled else ()
+        )
+        t_draft = tracer.now() if tracer.enabled else 0.0
+        dtoks, self._draft_caches = draft_chunk(
+            self._draft_lm,
+            self._draft_variables,
+            self._dstate["tok"],
+            self._dstate["pos"],
+            self._draft_caches,
+            n=d + 1,
+        )
+        if tracer.enabled:
+            # Dispatch-side cost of the draft scan; the verify span
+            # below carries the host sync. Tagged with the same request
+            # ids the framing headers use, so Perfetto correlates these
+            # rows with dispatcher/worker spans.
+            tracer.add_span(
+                "decode.draft",
+                start=t_draft,
+                end=tracer.now(),
+                slots=len(active),
+                draft_k=d,
+                requests=req_ids,
+            )
+        t_verify = tracer.now() if tracer.enabled else 0.0
+        toks, lps, acc, self._caches, self._dstate = self._spec_verify(
+            self.variables,
+            self._caches,
+            self._dstate,
+            dtoks,
+            self._current_table() if self._paged else None,
+        )
+        with self._cv:
+            self._ticks += 1
+        global_metrics().inc("continuous.ticks")
+        # The round's ONE host sync fetches all three arrays together.
+        toks, lps, acc = jax.device_get((toks, lps, acc))
+        toks, lps, acc = np.asarray(toks), np.asarray(lps), np.asarray(acc)
+        if tracer.enabled:
+            tracer.add_span(
+                "decode.verify",
+                start=t_verify,
+                end=tracer.now(),
+                slots=len(active),
+                draft_k=d,
+                requests=req_ids,
+            )
+        # Acceptance accounting: drafted/accepted proposals for the
+        # ACTIVE rows only (idle rows verify garbage nobody commits).
+        # Both counters move under _cv so a concurrent stats() snapshot
+        # cannot tear across them (the ADVICE-r4 rule the other
+        # lifetime counters follow).
+        acc_counts = [int(acc[s.idx]) for s in active]
+        with self._cv:
+            self._spec_drafted += d * len(active)
+            self._spec_accepted += sum(acc_counts)
+            ratio = (
+                self._spec_accepted / self._spec_drafted
+                if self._spec_drafted
+                else 0.0
+            )
+        global_metrics().set_gauge("continuous.spec_acceptance", ratio)
+        if self.obs_timeline:
+            # One histogram sample per active slot per tick (one
+            # registry-lock hold, like the ITL flush).
+            global_metrics().observe_many(
+                "continuous.spec_accepted_per_tick",
+                [float(a) for a in acc_counts],
+            )
+        return toks, lps, acc + 1
 
     def tick(self) -> int:
         """Admit waiting requests into free slots, run ONE prefill chunk
-        for each slot mid-chunked-prefill, then ONE chunk of lockstep
-        decode steps (a single compiled scan + one host sync) for the
-        decoding slots. Returns the number of active slots that
-        consumed the decode chunk (0 = no decoding happened this
-        tick)."""
+        for each slot mid-chunked-prefill, then decode: one chunk of
+        lockstep steps (a single compiled scan + one host sync) — or,
+        in speculative mode, one draft-scan + fused-verify round that
+        commits 1..draft_k+1 tokens per slot (``_spec_decode``).
+        Returns the number of active slots that consumed the decode
+        pass (0 = no decoding happened this tick)."""
         self._admit()
         for slot in self.slots:
             if slot.req is None:
@@ -1290,46 +1609,55 @@ class ContinuousBatcher:
         )
         if not active:
             return 0
-        C = self.chunk
-        # The whole per-slot staging block the old path rebuilt and
-        # transferred here every tick (tokens/pos/keys/temps/top_ks/
-        # top_ps/greedy — O(slots x fields) jnp.asarray calls) is GONE:
-        # the state already lives on device (_dstate, staged once per
-        # admission), so a steady-state tick stages zero host scalars
-        # and the paged table re-uploads only when it changed.
-        truncate = any(s.req.top_k < self.lm.vocab for s in active)
-        nucleus = any(s.req.top_p < 1.0 for s in active)
         tracer = global_tracer()
-        t_chunk = tracer.now() if tracer.enabled else 0.0
-        toks, lps, self._caches, self._dstate = self._step_chunk(
-            self.variables,
-            self._caches,
-            self._dstate,
-            self._current_table() if self._paged else None,
-            truncate=truncate,
-            nucleus=nucleus,
-        )
-        with self._cv:
-            self._ticks += 1
-        global_metrics().inc("continuous.ticks")
-        # The chunk's ONE host sync fetches both arrays together.
-        toks, lps = jax.device_get((toks, lps))
-        toks, lps = np.asarray(toks), np.asarray(lps)
-        if tracer.enabled:
-            # Dispatch + host sync of one compiled decode chunk — the
-            # Perfetto row that shows tick cadence and chunk cost.
-            tracer.add_span(
-                "batcher.decode_chunk",
-                start=t_chunk,
-                end=tracer.now(),
-                slots=len(active),
-                chunk=C,
+        if self._spec is not None:
+            toks, lps, limits = self._spec_decode(active, tracer)
+        else:
+            C = self.chunk
+            # The whole per-slot staging block the old path rebuilt and
+            # transferred here every tick (tokens/pos/keys/temps/top_ks/
+            # top_ps/greedy — O(slots x fields) jnp.asarray calls) is
+            # GONE: the state already lives on device (_dstate, staged
+            # once per admission), so a steady-state tick stages zero
+            # host scalars and the paged table re-uploads only when it
+            # changed.
+            truncate = any(s.req.top_k < self.lm.vocab for s in active)
+            nucleus = any(s.req.top_p < 1.0 for s in active)
+            t_chunk = tracer.now() if tracer.enabled else 0.0
+            toks, lps, self._caches, self._dstate = self._step_chunk(
+                self.variables,
+                self._caches,
+                self._dstate,
+                self._current_table() if self._paged else None,
+                truncate=truncate,
+                nucleus=nucleus,
             )
+            with self._cv:
+                self._ticks += 1
+            global_metrics().inc("continuous.ticks")
+            # The chunk's ONE host sync fetches both arrays together.
+            toks, lps = jax.device_get((toks, lps))
+            toks, lps = np.asarray(toks), np.asarray(lps)
+            limits = np.full((toks.shape[1],), C, np.int64)
+            if tracer.enabled:
+                # Dispatch + host sync of one compiled decode chunk —
+                # the Perfetto row that shows tick cadence and chunk
+                # cost.
+                tracer.add_span(
+                    "batcher.decode_chunk",
+                    start=t_chunk,
+                    end=tracer.now(),
+                    slots=len(active),
+                    chunk=C,
+                )
         for i, slot in enumerate(self.slots):
             if slot.req is None or slot.pf_done >= 0:
                 continue
             req = slot.req
-            for j in range(C):
+            # limits[i] is the slot's committable token count this tick:
+            # the full chunk in lockstep mode, the accepted prefix + 1
+            # correction token in speculative mode (rows desynchronize).
+            for j in range(int(limits[i])):
                 self._commit(slot, int(toks[j, i]), float(lps[j, i]))
                 if slot.req is not req:  # finished (steps or EOS)
                     break
@@ -1394,6 +1722,18 @@ class ContinuousBatcher:
                     x.nbytes for x in jax.tree.leaves(self._caches)
                 ),
             }
+            if self._spec is not None:
+                out["spec_drafted"] = self._spec_drafted
+                out["spec_accepted"] = self._spec_accepted
+                out["spec_acceptance"] = (
+                    self._spec_accepted / self._spec_drafted
+                    if self._spec_drafted
+                    else 0.0
+                )
+                out["draft_cache_bytes"] = sum(
+                    x.nbytes
+                    for x in jax.tree.leaves(self._draft_caches)
+                )
             if self._paged:
                 ps = self._pager.stats()
                 out["pool_pages"] = ps.num_pages

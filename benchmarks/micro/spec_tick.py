@@ -1,0 +1,152 @@
+"""Tokens-per-weight-stream of the speculative serving tick.
+
+Decode is bandwidth-bound: every verify pass streams the TARGET model's
+weights once, so "committed tokens per verify pass" is the structural
+speedup batched speculation buys (``runtime/continuous.py`` speculative
+mode) — on TPU it converts directly into decode throughput; on this
+CPU driver it is measured as a COUNTER (like the other micro benches),
+alongside honest wall-clock numbers.
+
+Scenarios, spanning the acceptance range:
+
+- ``plain``     — the ordinary lockstep tick (chunk=1): 1 token per
+                  weight stream per slot, the baseline by definition.
+- ``perfect``   — draft IS the target (acceptance 1.0): the upper
+                  bound, ``draft_k + 1`` tokens per stream.
+- ``self_draft``— the target's own first 2 (of 4) blocks as the draft
+                  (a truncated-self draft, the classic mid-acceptance
+                  regime).
+- ``adversarial`` — an independent tiny draft (acceptance ~1/vocab):
+                  the floor, ~1 token per stream — speculation's
+                  break-even-at-worst contract.
+
+Each scenario fills all slots, reaches steady state, then measures N
+ticks: committed tokens / verify passes, wall ms per committed token,
+and host->device staging transfers per tick (the PR-1 contract: 0).
+
+One JSON line: value = perfect-draft tokens-per-weight-stream,
+``vs_baseline`` = value − 1.0 (the plain tick's ratio is 1 by
+definition). Per-scenario numbers ride as extra fields.
+
+Usage: ``python benchmarks/micro/spec_tick.py [--slots 4] [--ticks 12]
+[--draft-k 4]``
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks.common import emit, int_flag  # noqa: E402
+
+
+def _measure(bat, slots, n_ticks, steps):
+    """Fill all slots, settle, then measure N steady-state ticks.
+    Returns (tokens_per_pass, ms_per_token, h2d_per_tick, acceptance)."""
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    for _ in range(slots):
+        bat.submit(rng.randint(0, 37, size=6).astype(np.int32), steps)
+    bat.tick()  # admissions + first round
+    bat.tick()  # settle
+    emitted0 = sum(len(s.tokens) for s in bat.slots if s.req is not None)
+    h2d0 = bat.stats()["h2d_transfers"]
+    t0 = time.perf_counter()
+    for _ in range(n_ticks):
+        bat.tick()
+    wall = time.perf_counter() - t0
+    emitted1 = sum(len(s.tokens) for s in bat.slots if s.req is not None)
+    tokens = emitted1 - emitted0
+    h2d = (bat.stats()["h2d_transfers"] - h2d0) / n_ticks
+    # One verify pass (one target weight stream) per tick per measured
+    # window; the plain tick's chunk=1 scan is likewise 1 stream/tick.
+    per_pass = tokens / (n_ticks * slots)
+    ms_tok = wall * 1e3 / max(tokens, 1)
+    acc = bat.stats().get("spec_acceptance", None)
+    return per_pass, ms_tok, h2d, acc
+
+
+def main() -> int:
+    slots = int_flag(sys.argv, "--slots", 4)
+    n_ticks = int_flag(sys.argv, "--ticks", 12)
+    draft_k = int_flag(sys.argv, "--draft-k", 4)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+
+        from adapt_tpu.config import SpeculativeConfig
+        from adapt_tpu.models.transformer_lm import (
+            lm_tiny,
+            transformer_lm,
+        )
+        from adapt_tpu.runtime.continuous import ContinuousBatcher
+
+        lm = lm_tiny(vocab=37, max_len=192)
+        variables = lm.graph.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+        )
+        # Truncated-self draft: the target's own first 2 blocks (and
+        # embed/head) — node names line up, so the variables slice
+        # directly. Untrained weights, but the layer-prefix correlation
+        # gives a genuine mid-range acceptance.
+        self_draft = transformer_lm(37, 64, 2, 4, 128, 192,
+                                    name="self_draft")
+        self_vars = {
+            k: variables[k]
+            for k in ("embed", "decoder_block_0", "decoder_block_1",
+                      "head")
+        }
+        adv = transformer_lm(37, 32, 2, 2, 64, 192, name="adv_draft")
+        adv_vars = adv.graph.init(
+            jax.random.PRNGKey(9), jnp.zeros((1, 4), jnp.int32)
+        )
+        steps = (n_ticks + 8) * (draft_k + 1)
+        cfg = SpeculativeConfig(draft_k=draft_k)
+
+        plain = ContinuousBatcher(lm, variables, slots=slots, chunk=1)
+        results = {"plain": _measure(plain, slots, n_ticks, steps)}
+        for name, d_lm, d_vars in (
+            ("perfect", lm, variables),
+            ("self_draft", self_draft, self_vars),
+            ("adversarial", adv, adv_vars),
+        ):
+            bat = ContinuousBatcher(
+                lm, variables, slots=slots, draft_lm=d_lm,
+                draft_variables=d_vars, speculative=cfg,
+            )
+            results[name] = _measure(bat, slots, n_ticks, steps)
+
+        extras = {}
+        for name, (per_pass, ms_tok, h2d, acc) in results.items():
+            extras[f"{name}_tokens_per_stream"] = round(per_pass, 3)
+            extras[f"{name}_ms_per_token"] = round(ms_tok, 3)
+            extras[f"{name}_h2d_per_tick"] = h2d
+            if acc is not None:
+                extras[f"{name}_acceptance"] = round(acc, 3)
+        value = results["perfect"][0]
+        emit(
+            "micro_spec_tick_tokens_per_stream",
+            round(value, 3),
+            "tokens/target-weight-stream",
+            round(value - results["plain"][0], 3),
+            slots=slots,
+            ticks=n_ticks,
+            draft_k=draft_k,
+            **extras,
+        )
+    except Exception as e:  # noqa: BLE001 — always one JSON line, rc 0
+        emit("micro_spec_tick_tokens_per_stream", 0.0,
+             "tokens/target-weight-stream", 0.0, error=str(e)[-300:])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,14 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long parameterizations excluded from the tier-1 run "
+        "(ROADMAP.md runs -m 'not slow')",
+    )
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():
     """Bound per-process XLA state: after ~240 accumulated compiled

@@ -95,6 +95,39 @@ def test_paged_chunk_kernel_matches_oracle(rng):
         )
 
 
+def test_paged_verify_kernel_matches_oracle(rng):
+    """Batched verify kernel (per-SLOT base positions, per-row causal
+    diagonal — the speculative tick's attention) vs its gather oracle:
+    desynchronized indices, GQA folding, and a sliding window."""
+    from adapt_tpu.ops.paged_attention import (
+        paged_verify_attention,
+        paged_verify_attention_reference,
+    )
+
+    b, kvh, g, chunk, hd, page, npages = 2, 2, 2, 5, 64, 128, 16
+    q = jax.random.normal(rng, (b, kvh, g * chunk, hd))
+    kp = jax.random.normal(
+        jax.random.fold_in(rng, 1), (npages, kvh, page, hd)
+    )
+    vp = jax.random.normal(
+        jax.random.fold_in(rng, 2), (npages, kvh, page, hd)
+    )
+    table = jnp.asarray([[3, 7, 1, 0], [5, 2, 9, 4]], jnp.int32)
+    index = jnp.asarray([301, 77], jnp.int32)  # rows desynchronized
+    for window in (None, 130):
+        ref = paged_verify_attention_reference(
+            q, kp, vp, table, index, chunk, window=window
+        )
+        out = paged_verify_attention(
+            q, kp, vp, table, index, chunk, prefer="pallas",
+            window=window,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg=f"window={window}",
+        )
+
+
 def test_paged_kernel_unsupported_page_size_falls_back(rng):
     # page 16 is not a lane multiple: prefer="pallas" serves the oracle.
     b, kvh, g, hd, page, npages = 1, 2, 1, 64, 16, 8

@@ -103,6 +103,24 @@ def test_validation(big_setup, draft_setup):
         speculative_generate(lm, variables, prompt, 4, draft, dvars, draft_k=0)
 
 
+def test_one_host_transfer_per_round(big_setup, draft_setup):
+    """The serving-control-path contract, counter-asserted like
+    ``benchmarks/micro/tick_host_overhead.py``: acceptance is reduced
+    ON DEVICE and each round performs exactly ONE device->host fetch
+    (the packed [accepted, predictions] vector) — the old loop fetched
+    the proposals, re-uploaded them into the verify chunk, and fetched
+    the predictions separately (three transfers, two syncs)."""
+    lm, variables, prompt = big_setup
+    draft, dvars = draft_setup
+    _, stats = speculative_generate(
+        lm, variables, prompt, 12, draft, dvars, draft_k=4,
+        return_stats=True,
+    )
+    # One fetch per round plus the prefill's first token.
+    assert stats["host_fetches"] == stats["rounds"] + 1
+    assert stats["rounds"] >= 1
+
+
 def test_gqa_target_lossless(draft_setup):
     """Speculative decoding against a GQA target: verify_chunk's grouped
     query rows over the small cache must stay lossless vs generate()."""
