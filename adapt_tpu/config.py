@@ -164,12 +164,28 @@ class ObservabilityConfig:
     # the journal (flight-<unix_ts>.json) so the fault timeline that led
     # to the crash survives the process.
     snapshot_on_recovery: bool = True
+    # Engine-tier per-phase timing (utils.profiling.EngineObs): tick
+    # phases (admit/prefill/draft/verify/decode/commit/update) and
+    # pipeline stage/hop phases record engine.phase.<name>_s histograms
+    # (+ spans when tracing is on). One branch per phase site when
+    # False; enabled cost measured by benchmarks/micro/obs_overhead.py
+    # against the <5% tick budget. Enable-only, like trace_enabled.
+    obs_engine: bool = False
+    # Compile-sentinel warmup (utils.profiling.CompileSentinel): jit
+    # cache growth within a program's first N sentinel samples after
+    # (re-)registration is expected compilation; growth after that is
+    # flagged as an unintended recompile (engine.compile_events counter,
+    # flight event, WARNING, tracer instant event). Applied only when it
+    # differs from this default (same rule as the ring capacities).
+    compile_warmup: int = 8
 
     def __post_init__(self):
         if self.trace_capacity < 1:
             raise ValueError("trace_capacity must be >= 1")
         if self.flight_capacity < 1:
             raise ValueError("flight_capacity must be >= 1")
+        if self.compile_warmup < 0:
+            raise ValueError("compile_warmup must be >= 0")
 
 
 @dataclasses.dataclass(frozen=True)

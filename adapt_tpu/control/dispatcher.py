@@ -26,6 +26,7 @@ import queue
 import random
 import threading
 import time
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any
@@ -44,9 +45,19 @@ from adapt_tpu.control.worker import (
 from adapt_tpu.graph.partition import PartitionPlan
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
+from adapt_tpu.utils.profiling import (
+    aggregate_size_fn,
+    global_compile_sentinel,
+    global_engine_obs,
+)
 from adapt_tpu.utils.tracing import global_flight_recorder, global_tracer
 
 log = get_logger("dispatcher")
+
+#: Live dispatchers (weak): per-stage compile watches SUM across them
+#: (profiling.aggregate_size_fn) — a second dispatcher must not
+#: silently unwatch the first.
+_LIVE_DISPATCHERS: "weakref.WeakSet[Dispatcher]" = weakref.WeakSet()
 
 
 class RequestFailed(RuntimeError):
@@ -144,6 +155,13 @@ class Dispatcher:
             global_tracer().set_capacity(obs.trace_capacity)
         if obs.flight_capacity != _obs_defaults.flight_capacity:
             global_flight_recorder().set_capacity(obs.flight_capacity)
+        # Engine-tier knobs ride the same apply-only-when-opinionated
+        # rules: obs_engine is enable-only, compile_warmup applies only
+        # when non-default (utils.profiling).
+        if obs.obs_engine:
+            global_engine_obs().enabled = True
+        if obs.compile_warmup != _obs_defaults.compile_warmup:
+            global_compile_sentinel().warmup_samples = obs.compile_warmup
         self.registry = registry or WorkerRegistry(
             default_ttl_s=self.config.fault.lease_ttl_s
         )
@@ -153,6 +171,24 @@ class Dispatcher:
         self._stage_fns = [
             jax.jit(plan.stage_apply(spec)) for spec in plan.stages
         ]
+        # Compile-sentinel watch on the stage programs: a failover
+        # re-bind is supposed to be a weight move, never a recompile —
+        # the sentinel turns a violation into a counted, logged event.
+        # Watches sum over the weakly-held live-dispatcher set (two
+        # concurrent dispatchers aggregate, neither is silently
+        # unwatched; a collected dispatcher's stages drop out).
+        _LIVE_DISPATCHERS.add(self)
+        for i in range(len(self._stage_fns)):
+            global_compile_sentinel().register(
+                f"dispatch.stage{i}",
+                size_fn=aggregate_size_fn(
+                    _LIVE_DISPATCHERS,
+                    lambda d, i=i: (
+                        d._stage_fns[i]._cache_size()
+                        if i < len(d._stage_fns) else None
+                    ),
+                ),
+            )
         self._stage_host_vars = plan.extract_variables(variables)
         # Precompiled re-shard plans (SURVEY.md §7.2.5): example input spec
         # per stage (recorded on first dispatch) + the set of (stage,

@@ -147,6 +147,7 @@ import collections
 import dataclasses
 import threading
 import time
+import weakref
 from functools import partial
 from collections.abc import Callable
 from typing import Any
@@ -166,9 +167,30 @@ from adapt_tpu.models.transformer_lm import (
 from adapt_tpu.runtime.paged import Pager, insert_prefill_pages
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
+from adapt_tpu.utils.profiling import (
+    aggregate_size_fn,
+    global_compile_sentinel,
+    global_engine_obs,
+    register_memory_source,
+    unregister_memory_source,
+)
 from adapt_tpu.utils.tracing import global_flight_recorder, global_tracer
 
 log = get_logger("continuous")
+
+#: Live batchers (weak — telemetry must never pin a retired batcher's
+#: device arrays). The ONE "continuous.prefill" sentinel watch sums the
+#: per-instance prefill jit families over this set
+#: (profiling.aggregate_size_fn), so a second batcher's construction
+#: aggregates rather than silently replacing the first one's watch,
+#: and closing the last batcher prunes the watch.
+_LIVE_BATCHERS: "weakref.WeakSet[ContinuousBatcher]" = weakref.WeakSet()
+
+
+def _prefill_family_size(bat: "ContinuousBatcher") -> int:
+    # list(): a ticking thread may be inserting a new bucket's jit
+    # closure while an exporter scrape sums.
+    return sum(f._cache_size() for f in list(bat._prefill_cache.values()))
 
 
 @dataclasses.dataclass
@@ -488,6 +510,44 @@ class ContinuousBatcher:
         #: this flag.
         self.obs_timeline = True
         self._itl_pending: list[float] = []
+        #: Engine-tier observability (utils.profiling): per-phase tick
+        #: timing behind the process-global EngineObs gate (one branch
+        #: per phase when off), plus the compile sentinel sampled once
+        #: per tick. Registration re-arms each program's warmup window —
+        #: jit caches key on ``self``, so a fresh batcher legitimately
+        #: compiles its own first variants.
+        self._eobs = global_engine_obs()
+        self._sentinel = global_compile_sentinel()
+        self._sentinel.register(
+            "continuous.step_chunk", type(self)._step_chunk
+        )
+        self._sentinel.register(
+            "continuous.stage_slot", type(self)._stage_slot
+        )
+        self._sentinel.register(
+            "continuous.clear_slot", type(self)._clear_slot
+        )
+        self._sentinel.register("continuous.insert", type(self)._insert)
+        if self._spec:
+            self._sentinel.register(
+                "continuous.spec_verify", type(self)._spec_verify
+            )
+            self._sentinel.register("speculative.draft_chunk", draft_chunk)
+        # The prefill family is a per-instance dict of jit closures
+        # (bucket/suffix/draft variants): ONE shared watch sums the
+        # cache sizes over every live batcher (weakly held), so a
+        # second batcher aggregates instead of replacing the first's
+        # watch. A late new-bucket admission fires the sentinel by
+        # design — that tick really did pay a compile.
+        _LIVE_BATCHERS.add(self)
+        self._sentinel.register(
+            "continuous.prefill",
+            size_fn=aggregate_size_fn(_LIVE_BATCHERS, _prefill_family_size),
+        )
+        #: Pull-style memory accounting: dense strip / pool / draft
+        #: bytes and paged occupancy served as memory.* gauges at every
+        #: exporter scrape (weakly held — see utils.profiling).
+        register_memory_source("continuous", self)
         # Threaded serving (start()/result()/stop()): one condition
         # guards every mutation of the queue/done handoff state and the
         # server-thread lifecycle; compiled work runs outside the lock,
@@ -1337,6 +1397,14 @@ class ContinuousBatcher:
                 self._admitting = None  # slot-bound: visible to cancel()
                 self._admitted += 1
             global_metrics().inc("continuous.admitted")
+            if self._paged:
+                # Prefix-cache effectiveness per admission: prompt pages
+                # REUSED from the content-addressed cache instead of
+                # recomputed (0 on a cold admission). Per-admission, not
+                # per-token — always on, like the flight events.
+                global_metrics().observe(
+                    "paged.pages_reused_per_admission", float(m)
+                )
             queue_wait = time.perf_counter() - req.t_submit
             if self.obs_timeline:
                 global_metrics().observe(
@@ -1488,6 +1556,12 @@ class ContinuousBatcher:
         sync. Returns host-side ((d+1, B) tokens, logprobs, (B,)
         per-slot commit limits)."""
         d = self._spec_k
+        eo = self._eobs
+        # Snapshot the gate ONCE per call: flipping obs_engine while a
+        # tick is in flight must never pair a 0.0 open with an enabled
+        # close (a perf-counter-sized garbage histogram sample).
+        eo_on = eo.enabled
+        t_ph = eo.now() if eo_on else 0.0
         # Only the span tags consume the id tuple — don't build it on
         # the untraced hot path.
         req_ids = (
@@ -1515,6 +1589,9 @@ class ContinuousBatcher:
                 draft_k=d,
                 requests=req_ids,
             )
+        if eo_on:
+            # span=False: decode.draft above is the tracer row.
+            t_ph = eo.phase("draft", t_ph, span=False)
         t_verify = tracer.now() if tracer.enabled else 0.0
         toks, lps, acc, self._caches, self._dstate = self._spec_verify(
             self.variables,
@@ -1538,6 +1615,10 @@ class ContinuousBatcher:
                 draft_k=d,
                 requests=req_ids,
             )
+        if eo_on:
+            # Ends after the round's ONE fused host fetch (decode.verify
+            # is the tracer row for the same window).
+            eo.phase("verify", t_ph, span=False)
         # Acceptance accounting: drafted/accepted proposals for the
         # ACTIVE rows only (idle rows verify garbage nobody commits).
         # Both counters move under _cv so a concurrent stats() snapshot
@@ -1569,8 +1650,22 @@ class ContinuousBatcher:
         in speculative mode, one draft-scan + fused-verify round that
         commits 1..draft_k+1 tokens per slot (``_spec_decode``).
         Returns the number of active slots that consumed the decode
-        pass (0 = no decoding happened this tick)."""
+        pass (0 = no decoding happened this tick).
+
+        Engine-tier phase timing (``utils.profiling.EngineObs``,
+        ``obs_engine``): admit / prefill / draft / verify / decode /
+        commit / update each record one ``engine.phase.<name>_s``
+        histogram sample per tick when enabled; disabled, each site
+        costs one branch. The compile sentinel samples once at the end
+        of every tick, so an unexpected recompile is flagged next to
+        the tick that paid for it."""
+        eo = self._eobs
+        # Snapshot the gate ONCE per tick (see _spec_decode).
+        eo_on = eo.enabled
+        t_ph = eo.now() if eo_on else 0.0
         self._admit()
+        if eo_on:
+            t_ph = eo.phase("admit", t_ph)
         for slot in self.slots:
             if slot.req is None:
                 continue
@@ -1582,6 +1677,8 @@ class ContinuousBatcher:
         for slot in self.slots:
             if slot.req is not None and slot.pf_done >= 0:
                 self._prefill_step(slot)  # interleaves with decode below
+        if eo_on:
+            eo.phase("prefill", t_ph)
         active = [
             s for s in self.slots
             if s.req is not None and s.pf_done < 0
@@ -1608,11 +1705,13 @@ class ContinuousBatcher:
             "continuous.h2d_transfers", float(self._h2d_count)
         )
         if not active:
+            self._sentinel.sample(write_gauges=False)
             return 0
         tracer = global_tracer()
         if self._spec is not None:
             toks, lps, limits = self._spec_decode(active, tracer)
         else:
+            t_ph = eo.now() if eo_on else 0.0
             C = self.chunk
             # The whole per-slot staging block the old path rebuilt and
             # transferred here every tick (tokens/pos/keys/temps/top_ks/
@@ -1650,6 +1749,11 @@ class ContinuousBatcher:
                     slots=len(active),
                     chunk=C,
                 )
+            if eo_on:
+                # span=False: batcher.decode_chunk above is already the
+                # tracer row for this window.
+                eo.phase("decode", t_ph, span=False)
+        t_ph = eo.now() if eo_on else 0.0
         for i, slot in enumerate(self.slots):
             if slot.req is None or slot.pf_done >= 0:
                 continue
@@ -1665,6 +1769,8 @@ class ContinuousBatcher:
                 # pos invariant at tick entry: the next step consumes
                 # last_token (stream index emitted-1) at s0 + emitted - 1.
                 slot.pos = slot.s0 + slot.emitted - 1
+        if eo_on:
+            t_ph = eo.phase("commit", t_ph)
         if self._paged and self._window is not None:
             # Rolling-window recycling: pages wholly behind every future
             # read ((o+1)*P <= pos - window + 1 — reads from here on
@@ -1691,6 +1797,11 @@ class ContinuousBatcher:
             "continuous.active_slots",
             sum(1 for sl in self.slots if sl.req is not None),
         )
+        if eo_on:
+            # "update" = post-commit bookkeeping: window recycling, the
+            # batched ITL flush, occupancy gauges.
+            eo.phase("update", t_ph)
+        self._sentinel.sample(write_gauges=False)
         return len(active)
 
     def stats(self) -> dict:
@@ -1743,6 +1854,47 @@ class ContinuousBatcher:
                 out["prefix_hits"] = ps.prefix_hits
                 out["prefix_misses"] = ps.prefix_misses
                 out["prefix_capacity_skips"] = ps.prefix_capacity_skips
+        return out
+
+    def _memory_stats(self) -> dict[str, float]:
+        """Pull-style memory source for ``utils.profiling``'s engine
+        collector (runs on exporter scrape threads — reads only, no
+        locks, tolerant of racing a live tick). Keys are final metric
+        names; the collector SUMS across live batchers:
+
+        - dense layout: ``memory.kv_bytes`` (slot strip bytes, int8
+          value+scale pairs included);
+        - paged layout: ``memory.pool_bytes`` plus page occupancy —
+          ``memory.pages_used + pages_free + pages_cached ==
+          memory.pool_pages`` (allocatable pool, trash page excluded) —
+          and the pager's prefix-cache effectiveness counters
+          (``paged.prefix_{hits,misses,capacity_skips}``);
+        - speculative mode: ``memory.draft_cache_bytes``.
+        """
+        cache_bytes = float(
+            sum(x.nbytes for x in jax.tree.leaves(self._caches))
+        )
+        out: dict[str, float] = {}
+        if self._paged:
+            ps = self._pager.stats()
+            out["memory.pool_bytes"] = cache_bytes
+            out["memory.pool_pages"] = float(ps.num_pages - 1)
+            out["memory.pages_used"] = float(ps.in_use)
+            out["memory.pages_cached"] = float(ps.cached)
+            # PagerStats.free counts evictable cached pages as free
+            # (allocator view); the gauges partition instead.
+            out["memory.pages_free"] = float(ps.free - ps.cached)
+            out["paged.prefix_hits"] = float(ps.prefix_hits)
+            out["paged.prefix_misses"] = float(ps.prefix_misses)
+            out["paged.prefix_capacity_skips"] = float(
+                ps.prefix_capacity_skips
+            )
+        else:
+            out["memory.kv_bytes"] = cache_bytes
+        if self._draft_caches is not None:
+            out["memory.draft_cache_bytes"] = float(
+                sum(x.nbytes for x in jax.tree.leaves(self._draft_caches))
+            )
         return out
 
     def logprobs(self, req_id: int) -> np.ndarray:
@@ -1847,6 +1999,17 @@ class ContinuousBatcher:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    def close(self) -> None:
+        """Retire this batcher from the engine telemetry: drop it from
+        the ``memory.*`` gauge sums and the shared prefill compile
+        watch. Needed because the jit caches pin ``self`` (static
+        argnum), so GC alone never removes a replaced batcher — without
+        close(), an operator swapping in a new batcher sees both
+        instances' bytes summed (a phantom leak). Idempotent; call
+        after :meth:`stop` when the batcher is permanently done."""
+        unregister_memory_source("continuous", self)
+        _LIVE_BATCHERS.discard(self)
 
     def result(self, req_id: int, timeout: float = 300.0) -> np.ndarray:
         """Block until ``req_id`` finishes (requires :meth:`start`);

@@ -38,6 +38,7 @@ from __future__ import annotations
 import itertools
 import queue
 import time
+import weakref
 from dataclasses import dataclass
 from typing import Any, Callable, Sequence
 
@@ -56,9 +57,29 @@ from adapt_tpu.models.transformer_lm import (
 )
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
+from adapt_tpu.utils.profiling import (
+    aggregate_size_fn,
+    global_compile_sentinel,
+)
 from adapt_tpu.utils.tracing import global_flight_recorder, global_tracer
 
 log = get_logger("decode_pipeline")
+
+#: Live PipelinedDecoders (weak): per-stage compile watches SUM across
+#: them (profiling.aggregate_size_fn) — a second decoder must not
+#: silently unwatch the first.
+_LIVE_DECODERS: "weakref.WeakSet[PipelinedDecoder]" = weakref.WeakSet()
+
+
+def _program_size(which: str, i: int):
+    """Extractor for the decoder stage watches: stage ``i``'s
+    ``which`` jit cache size, None when this decoder has no stage
+    ``i``."""
+    def extract(dec):
+        if i >= len(dec.programs):
+            return None
+        return getattr(dec.programs[i], which)._cache_size()
+    return extract
 
 
 class _ReplayFailure(RuntimeError):
@@ -237,6 +258,28 @@ class PipelinedDecoder:
         self.programs = _build_stage_programs(
             lm, variables, boundaries, kv_quant=kv_cache_dtype == "int8"
         )
+        # Compile-sentinel watch (utils.profiling): recovery re-binds a
+        # stage to a spare device WITHOUT recompiling (the <2 s budget);
+        # post-warmup cache growth here means a recovery actually paid
+        # for an XLA compile — counted and logged, not silent. Watches
+        # sum over the weakly-held live-decoder set (two concurrent
+        # decoders aggregate, neither is silently unwatched; a
+        # collected decoder's programs drop out).
+        _LIVE_DECODERS.add(self)
+        sentinel = global_compile_sentinel()
+        for i in range(len(self.programs)):
+            sentinel.register(
+                f"decode.stage{i}.prefill",
+                size_fn=aggregate_size_fn(
+                    _LIVE_DECODERS, _program_size("prefill_fn", i)
+                ),
+            )
+            sentinel.register(
+                f"decode.stage{i}.decode",
+                size_fn=aggregate_size_fn(
+                    _LIVE_DECODERS, _program_size("decode_fn", i)
+                ),
+            )
         devices = list(devices if devices is not None else jax.devices())
         if not devices:
             raise ValueError("no devices")

@@ -212,10 +212,15 @@ class Pager:
         self._key_of[page] = key
 
     def stats(self) -> PagerStats:
+        # list(...) snapshots the dict at C speed: stats() is now also
+        # read from exporter scrape threads (the memory collector in
+        # utils.profiling) while the ticking thread mutates _rc, and a
+        # generator over live .values() could raise "dict changed size
+        # during iteration" mid-scrape.
         return PagerStats(
             num_pages=self.num_pages,
             free=len(self._free) + len(self._lru),
-            in_use=sum(1 for r in self._rc.values() if r > 0),
+            in_use=sum(1 for r in list(self._rc.values()) if r > 0),
             cached=len(self._lru),
             prefix_hits=self.prefix_hits,
             prefix_misses=self.prefix_misses,

@@ -19,6 +19,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import weakref
 from collections.abc import Iterable, Sequence
 from typing import Any
 
@@ -31,11 +32,21 @@ from adapt_tpu.core.stage import CompiledStage, compile_stages
 from adapt_tpu.graph.partition import PartitionPlan
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
+from adapt_tpu.utils.profiling import (
+    aggregate_size_fn,
+    global_compile_sentinel,
+    global_engine_obs,
+)
 from adapt_tpu.utils.tracing import global_tracer
 
 log = get_logger("pipeline")
 
 _SENTINEL = object()
+
+#: Live LocalPipelines (weak): the per-stage compile watches SUM across
+#: them (profiling.aggregate_size_fn), so a blue/green second pipeline
+#: aggregates rather than silently replacing the first one's watch.
+_LIVE_PIPELINES: "weakref.WeakSet[LocalPipeline]" = weakref.WeakSet()
 
 
 class _StageError:
@@ -87,6 +98,25 @@ class LocalPipeline:
         self.stages: list[CompiledStage] = compile_stages(
             plan, variables, devices, donate_activations=donate_activations
         )
+        # Compile-sentinel watch (utils.profiling): a static-chain
+        # stage's jit should compile once per device kind; growth after
+        # warmup is a counted, logged recompile event. Watches sum over
+        # the weakly-held live-pipeline set: two concurrent pipelines
+        # aggregate (neither is silently unwatched), and telemetry
+        # never pins a torn-down pipeline's jit wrappers.
+        _LIVE_PIPELINES.add(self)
+        sentinel = global_compile_sentinel()
+        for i in range(len(self.stages)):
+            sentinel.register(
+                f"pipeline.stage{i}",
+                size_fn=aggregate_size_fn(
+                    _LIVE_PIPELINES,
+                    lambda p, i=i: (
+                        p.stages[i].fn._cache_size()
+                        if i < len(p.stages) else None
+                    ),
+                ),
+            )
 
     @classmethod
     def from_config(
@@ -166,6 +196,11 @@ class LocalPipeline:
         ]
 
         tracer = global_tracer()
+        # Engine-tier phase timing (obs_engine): stage/hop dispatch
+        # histograms, one branch per item when disabled. span=False —
+        # the pipeline.stage/pipeline.hop tracer spans above each site
+        # already cover the same window.
+        eobs = global_engine_obs()
 
         def stage_loop(i: int):
             stage = self.stages[i]
@@ -181,10 +216,14 @@ class LocalPipeline:
                     # `seq` is the stream ordinal — together with the
                     # hop spans below, Perfetto shows stage i computing
                     # request r+1 while its hop for r is in flight.
+                    eo_on = eobs.enabled
+                    t_ph = eobs.now() if eo_on else 0.0
                     with tracer.span(
                         "pipeline.stage", stage=i, seq=seq
                     ):
                         y = stage(item)
+                    if eo_on:
+                        eobs.phase("stage", t_ph, span=False)
                 except Exception as e:  # noqa: BLE001 — surface to caller
                     put_or_abort(out_q, _StageError(stage.spec.index, e))
                     break
@@ -203,8 +242,12 @@ class LocalPipeline:
                 try:
                     # The blocking host round-trip (codec fetch/encode):
                     # the span PR-1's hop threads exist to overlap.
+                    eo_on = eobs.enabled
+                    t_ph = eobs.now() if eo_on else 0.0
                     with tracer.span("pipeline.hop", stage=i, seq=seq):
                         y = self.hop_transform(y, stage.spec.index)
+                    if eo_on:
+                        eobs.phase("hop", t_ph, span=False)
                 except Exception as e:  # noqa: BLE001 — surface to caller
                     put_or_abort(qs[i + 1], _StageError(stage.spec.index, e))
                     break

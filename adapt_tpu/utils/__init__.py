@@ -1,6 +1,15 @@
 from adapt_tpu.utils.exporter import prometheus_text, serve_metrics
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import MetricsRegistry, global_metrics
+from adapt_tpu.utils.profiling import (
+    CompileSentinel,
+    EngineObs,
+    engine_collector,
+    global_compile_sentinel,
+    global_engine_obs,
+    register_memory_source,
+    unregister_memory_source,
+)
 from adapt_tpu.utils.tracing import (
     FlightRecorder,
     Tracer,
@@ -18,4 +27,11 @@ __all__ = [
     "global_flight_recorder",
     "Tracer",
     "global_tracer",
+    "CompileSentinel",
+    "EngineObs",
+    "engine_collector",
+    "global_compile_sentinel",
+    "global_engine_obs",
+    "register_memory_source",
+    "unregister_memory_source",
 ]

@@ -112,6 +112,12 @@ def serve_metrics(
     from adapt_tpu.comm.codec import _copy_stats_collector
 
     reg.register_collector(_copy_stats_collector)
+    # Engine-tier bridge (utils.profiling): memory gauges (KV strips,
+    # draft caches, paged pool occupancy, backend HBM) + a compile-
+    # sentinel sample per scrape, on the registry actually served.
+    from adapt_tpu.utils.profiling import engine_collector
+
+    reg.register_collector(engine_collector)
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 — http.server API

@@ -40,6 +40,25 @@ def get_logger(component: str) -> logging.Logger:
     return logging.getLogger(f"adapt_tpu.{component}")
 
 
+def _kv_value(v) -> str:
+    """One field value, quoted when unquoted rendering would be
+    unparseable: spaces or ``=`` inside a bare value make ``a=x y=1``
+    ambiguous to any key=value splitter, so such values (and ones
+    carrying quotes/newlines, or the empty string) render as a
+    double-quoted, backslash-escaped token."""
+    s = str(v)
+    if s and not any(
+        c in s for c in (" ", "=", '"', "\\", "\n", "\r", "\t")
+    ):
+        return s
+    s = s.replace("\\", "\\\\").replace('"', '\\"')
+    s = s.replace("\n", "\\n").replace("\r", "\\r").replace("\t", "\\t")
+    return f'"{s}"'
+
+
 def kv(**fields) -> str:
-    """Render key=value fields for structured log lines."""
-    return " ".join(f"{k}={v}" for k, v in fields.items())
+    """Render key=value fields for structured log lines. Values that
+    would break the line's key=value grammar are quoted
+    (:func:`_kv_value`), so ``kv(msg="send failed", peer="a=b")`` stays
+    machine-splittable on unquoted whitespace."""
+    return " ".join(f"{k}={_kv_value(v)}" for k, v in fields.items())

@@ -106,6 +106,13 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = value
 
+    def remove_gauge(self, name: str) -> None:
+        """Drop one gauge so snapshots stop serving its last value —
+        for sources that disappear (e.g. a retired program's
+        ``engine.compiles.*`` entry). No-op when absent."""
+        with self._lock:
+            self._gauges.pop(name, None)
+
     def observe(self, name: str, value: float) -> None:
         with self._lock:
             self._histograms[name].observe(value)

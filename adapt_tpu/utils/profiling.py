@@ -1,0 +1,470 @@
+"""Engine-tier observability: compile sentinel, memory accounting,
+tick-phase timing.
+
+PR 2 made the *request* tier visible (timelines, stitched spans, flight
+recorder); this module watches the *engine* underneath — the things that
+silently destroy TPU serving performance without ever failing a test:
+
+- :class:`CompileSentinel` — a registry of the serving hot-path jit
+  entry points (the continuous tick's decode/verify programs, the
+  admission setters, ``draft_chunk``, pipeline stage fns, the pipelined
+  decoder's per-stage programs). Each :meth:`~CompileSentinel.sample`
+  reads every registered program's jit cache size, exports it as an
+  ``engine.compiles.<program>`` gauge, and — after a configurable
+  warmup — treats ANY growth as an unintended recompile: it bumps the
+  ``engine.compile_events`` counter, records a ``recompile`` flight-
+  recorder event, logs a WARNING, and drops a zero-duration tracer
+  event so the recompile lands in the Perfetto timeline next to the
+  tick that paid for it. Static-shape serving (the Mesh-TensorFlow
+  discipline) makes "the cache grew" a precise proxy for "a tick just
+  stalled on XLA"; re-registering a program (every batcher constructor
+  does) re-arms its warmup, because jit caches key on ``self`` and a
+  new instance legitimately compiles its own first variants.
+
+- **Memory accounting** — pull-style: components register themselves as
+  weakly-held sources (:func:`register_memory_source`) exposing a
+  ``_memory_stats() -> {metric: value}`` dict, and
+  :func:`engine_collector` (hooked into ``MetricsRegistry.snapshot`` /
+  the exporter, like the codec copy-stats bridge) sums them at scrape
+  time into ``memory.*`` gauges: dense KV strip bytes, draft-cache
+  bytes, paged pool occupancy (``memory.pages_{used,free,cached}`` +
+  ``memory.pool_pages``/``pool_bytes``) and the pager's prefix-cache
+  effectiveness counters (``paged.prefix_{hits,misses}``). When the
+  backend provides ``device.memory_stats()`` (TPU/GPU; CPU does not),
+  ``memory.hbm_bytes_in_use`` / ``memory.hbm_bytes_limit`` ride along.
+  Sources are weakrefs: a retired batcher drops out of the gauges with
+  its arrays, never pinned by telemetry.
+
+- :class:`EngineObs` — the one-branch gate for per-phase tick timing
+  (``config.ObservabilityConfig.obs_engine``). Enabled, each serving
+  phase (admit / prefill / draft / verify / decode / commit / update in
+  ``ContinuousBatcher.tick``; stage / hop in ``LocalPipeline.stream``)
+  records an ``engine.phase.<name>_s`` histogram sample and, when the
+  tracer is on, a span — ``benchmarks/micro/obs_overhead.py`` measures
+  the enabled cost against the <5% tick budget. Disabled (default),
+  every phase site costs exactly one attribute check.
+
+Catalog + semantics: ``docs/OBSERVABILITY.md`` "Engine telemetry".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections.abc import Callable
+
+from adapt_tpu.utils.logging import get_logger, kv
+from adapt_tpu.utils.metrics import MetricsRegistry, global_metrics
+from adapt_tpu.utils.tracing import global_flight_recorder, global_tracer
+
+log = get_logger("profiling")
+
+
+# -- compile sentinel -------------------------------------------------------
+
+
+class _Watch:
+    __slots__ = ("size_fn", "last", "samples")
+
+    def __init__(self, size_fn: Callable[[], int]):
+        self.size_fn = size_fn
+        self.last: int | None = None
+        self.samples = 0
+
+
+class CompileSentinel:
+    """Watches registered jit entry points for unexpected recompiles.
+
+    ``register(name, fn)`` takes any jit-wrapped callable (jax exposes
+    the executable-cache size as ``fn._cache_size()``) or an explicit
+    0-arg ``size_fn`` (which may return ``None`` to say "my owner is
+    gone" — the watch is then pruned). :meth:`sample` is called once
+    per serving tick (and at every exporter scrape via
+    :func:`engine_collector`): cheap — one cache-size read per program
+    under one lock, plus one gauge write per program on the sampled
+    registry (every registry that samples gets the full
+    ``engine.compiles.*`` family, not just the one that happened to see
+    a change).
+
+    Warmup counts ACTIVE samples only — samples where the program has
+    compiled at least once (size > 0). A program registered at startup
+    and then scraped for an hour while the serve loop sits idle keeps
+    its full grace window: its first real compiles are expected, not
+    flagged. After ``warmup_samples`` active samples, any growth is an
+    unintended recompile (counter + flight event + WARNING + tracer
+    instant event). Growth during warmup still moves the gauge, so the
+    expected variant count is visible too.
+
+    One watch per name; re-registering re-arms the warmup and replaces
+    the size_fn (latest instance wins — right for class-level shared
+    jit caches, where a fresh ``self`` legitimately compiles new
+    entries; per-instance program families should register ONE
+    aggregate size_fn over their live instances —
+    :func:`aggregate_size_fn` builds one). Event DETECTION happens
+    once, against the sentinel's own cumulative state; every sampling
+    registry's ``engine.compile_events`` counter is then synced up to
+    that cumulative count, so a custom registry served by the exporter
+    reports the same events as the process registry the ticks drive."""
+
+    def __init__(self, warmup_samples: int = 8):
+        if warmup_samples < 0:
+            raise ValueError(
+                f"warmup_samples must be >= 0, got {warmup_samples}"
+            )
+        self._lock = threading.Lock()
+        self._watches: dict[str, _Watch] = {}
+        self.warmup_samples = warmup_samples
+        self._events = 0
+        #: Per-registry high-water mark of events already inc'd there
+        #: (weak keys: the sentinel must not pin test registries).
+        self._synced: "weakref.WeakKeyDictionary[MetricsRegistry, int]" = (
+            weakref.WeakKeyDictionary()
+        )
+        #: Tombstones of pruned watches: every sample clears their
+        #: stale ``engine.compiles.*`` gauge from the sampled registry
+        #: (a retired program must not scrape as still-compiled).
+        #: Bounded by the set of program names ever watched.
+        self._pruned: set[str] = set()
+
+    def register(
+        self,
+        name: str,
+        fn=None,
+        *,
+        size_fn: Callable[[], int] | None = None,
+    ) -> None:
+        """Watch ``name``. Re-registering (same or different fn) re-arms
+        the warmup window — constructors re-register their class-level
+        jits precisely because a fresh ``self`` legitimately compiles
+        fresh cache entries."""
+        if size_fn is None:
+            if fn is None or not hasattr(fn, "_cache_size"):
+                raise TypeError(
+                    f"{name}: need a jit-wrapped fn (with _cache_size) "
+                    "or an explicit size_fn"
+                )
+            size_fn = fn._cache_size
+        with self._lock:
+            self._watches[name] = _Watch(size_fn)
+            self._pruned.discard(name)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            if self._watches.pop(name, None) is not None:
+                self._pruned.add(name)
+
+    def watched(self) -> list[str]:
+        with self._lock:
+            return list(self._watches)
+
+    def compiles(self, name: str) -> int:
+        """Current executable-cache size of one watched program — the
+        public replacement for poking ``fn._cache_size()`` in tests."""
+        with self._lock:
+            size = self._watches[name].size_fn()
+        if size is None:
+            raise KeyError(f"{name}: watched program's owner is gone")
+        return int(size)
+
+    def counts(self) -> dict[str, int]:
+        """Current cache size of every watched program (one consistent
+        read pass; programs whose size_fn raises — or whose owner is
+        gone — are skipped)."""
+        out = {}
+        with self._lock:
+            for name, w in self._watches.items():
+                try:
+                    size = w.size_fn()
+                except Exception:  # noqa: BLE001 — a probe must not raise
+                    continue
+                if size is not None:
+                    out[name] = int(size)
+        return out
+
+    @property
+    def events(self) -> int:
+        """Lifetime count of unexpected post-warmup compiles (summed
+        new executables across all programs) — the cumulative value
+        every sampling registry's ``engine.compile_events`` counter
+        converges to."""
+        with self._lock:
+            return self._events
+
+    def sample(
+        self,
+        registry: MetricsRegistry | None = None,
+        *,
+        write_gauges: bool = True,
+    ) -> int:
+        """One sentinel pass over every watched program. Returns the
+        number of unexpected-recompile events fired. ``registry``
+        defaults to the process-global one (the exporter passes the
+        registry actually being scraped). ``write_gauges=False`` is the
+        hot tick path's detection-only mode: it skips the per-program
+        gauge writes and tombstone cleanup (one registry-lock acquire
+        each), which every scrape refreshes anyway via
+        :func:`engine_collector` — detection, the event counter sync
+        and the flight/log/tracer side effects still run."""
+        reg = registry if registry is not None else global_metrics()
+        fired: list[tuple[str, int, int]] = []  # (name, size, delta)
+        sizes: list[tuple[str, int]] = []
+        dead: list[str] = []
+        with self._lock:
+            for name, w in self._watches.items():
+                try:
+                    raw = w.size_fn()
+                except Exception:  # noqa: BLE001 — a sick probe is skipped
+                    continue
+                if raw is None:  # owner retired: prune the watch
+                    dead.append(name)
+                    continue
+                size = int(raw)
+                sizes.append((name, size))
+                # Warmup advances only while the program is ACTIVE
+                # (compiled at least once): idle-process scrapes must
+                # not burn the grace window before the first request.
+                warmed = w.samples >= self.warmup_samples
+                if size > 0:
+                    w.samples += 1
+                if w.last is None or size == w.last:
+                    w.last = size
+                    continue
+                delta = size - w.last
+                w.last = size
+                if delta > 0 and warmed:
+                    fired.append((name, size, delta))
+                    self._events += delta
+            for name in dead:
+                del self._watches[name]
+            self._pruned.update(dead)
+            tombstones = list(self._pruned)
+            # Sync this registry's counter to the cumulative event
+            # count: detection is sentinel-global, so a registry that
+            # was not the one sampling when an event fired still
+            # converges to the same engine.compile_events total.
+            behind = self._events - self._synced.get(reg, 0)
+            if behind > 0:
+                self._synced[reg] = self._events
+        # Registry / recorder / tracer writes happen outside the
+        # sentinel lock (each has its own locking; no nesting). Gauges
+        # are written unconditionally: a registry that samples less
+        # often than the ticking one must still serve current values.
+        if behind > 0:
+            reg.inc("engine.compile_events", float(behind))
+        if write_gauges:
+            for name, size in sizes:
+                reg.set_gauge(f"engine.compiles.{name}", float(size))
+            for name in tombstones:
+                # A retired program must not scrape as still-compiled.
+                reg.remove_gauge(f"engine.compiles.{name}")
+        tracer = global_tracer()
+        for name, size, delta in fired:
+            global_flight_recorder().record(
+                "recompile", program=name, compiles=size, new=delta
+            )
+            log.warning(
+                "unexpected recompile %s",
+                kv(program=name, compiles=size, new=delta),
+            )
+            if tracer.enabled:
+                tracer.instant("engine.recompile", program=name, new=delta)
+        return len(fired)
+
+
+def snapshot_weak(owners) -> list:
+    """Snapshot a WeakSet that another thread may be ``add()``-ing to:
+    WeakSet iteration is Python-level, so even ``list(owners)`` can
+    raise ``RuntimeError: Set changed size during iteration`` when a
+    constructor registers concurrently with an exporter scrape.
+    Bounded retries; a PERSISTENT race re-raises — callers in sentinel
+    size_fns deliberately let it escape, because the sentinel skips a
+    watch whose probe raises (sample untouched, retried next pass),
+    whereas returning an empty/zero snapshot would be misread as "no
+    owners" (pruning a live watch) or "cache size 0" (arming a false
+    recompile event on recovery)."""
+    last_err = None
+    for _ in range(4):
+        try:
+            return list(owners)
+        except RuntimeError as e:
+            last_err = e
+    raise last_err
+
+
+def aggregate_size_fn(owners, extract: Callable) -> Callable:
+    """Build a sentinel ``size_fn`` that SUMS a per-owner cache size
+    over a weakly-held owner collection (one shared watch per program
+    name — a second live instance aggregates instead of silently
+    replacing the first's watch, and a collected owner drops out).
+
+    ``extract(owner) -> int | None`` returns the owner's cache size for
+    the watched program, or None when the owner does not carry it
+    (e.g. a pipeline with fewer stages). When NO live owner matches,
+    the size_fn returns None and the sentinel prunes the watch."""
+
+    def size_fn():
+        sizes = [
+            s
+            for s in (extract(o) for o in snapshot_weak(owners))
+            if s is not None
+        ]
+        if not sizes:
+            return None
+        return sum(sizes)
+
+    return size_fn
+
+
+_SENTINEL = CompileSentinel()
+
+
+def global_compile_sentinel() -> CompileSentinel:
+    return _SENTINEL
+
+
+# -- memory accounting ------------------------------------------------------
+
+#: Weakly-held memory sources: (label, id) -> object exposing
+#: ``_memory_stats() -> {metric_name: value}``. Weak values: a retired
+#: batcher (and its device arrays) must never be pinned by telemetry.
+_MEMORY_SOURCES: "weakref.WeakValueDictionary[tuple[str, int], object]" = (
+    weakref.WeakValueDictionary()
+)
+_MEMORY_LOCK = threading.Lock()
+#: Per-registry set of memory gauge names the collector wrote on its
+#: previous pass: names that stop being produced (their sources
+#: retired — e.g. a closed paged batcher's pool gauges) are REMOVED
+#: from that registry instead of serving their last value forever.
+_MEMORY_WRITTEN: "weakref.WeakKeyDictionary[MetricsRegistry, set]" = (
+    weakref.WeakKeyDictionary()
+)
+
+
+def register_memory_source(label: str, obj) -> None:
+    """Register ``obj`` (anything with ``_memory_stats() -> dict``) as a
+    pull-style memory source. Held by weakref; keyed by ``(label,
+    id(obj))`` so several batchers coexist and gauges SUM across the
+    live ones. NOTE: a source whose own jit caches pin it (a batcher —
+    ``static_argnums=(0,)`` holds ``self`` strongly) is never collected
+    by GC, so retiring such a component must call
+    :func:`unregister_memory_source` (``ContinuousBatcher.close``
+    does), or the replaced instance keeps summing into the gauges."""
+    if not hasattr(obj, "_memory_stats"):
+        raise TypeError(f"{label}: source must expose _memory_stats()")
+    with _MEMORY_LOCK:
+        _MEMORY_SOURCES[(label, id(obj))] = obj
+
+
+def unregister_memory_source(label: str, obj) -> None:
+    """Drop ``obj`` from the gauge sums (idempotent). For components
+    whose jit caches pin them alive — explicit retirement is the only
+    way their bytes leave the gauges."""
+    with _MEMORY_LOCK:
+        _MEMORY_SOURCES.pop((label, id(obj)), None)
+
+
+def _device_memory_stats() -> dict[str, float]:
+    """``memory.hbm_*`` from the backend, when it reports them (TPU/GPU
+    backends do; CPU returns None/raises — then nothing is exported,
+    rather than a lying zero)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — no backend / no stats: no gauges
+        return {}
+    if not stats:
+        return {}
+    out = {}
+    if "bytes_in_use" in stats:
+        out["memory.hbm_bytes_in_use"] = float(stats["bytes_in_use"])
+    if "bytes_limit" in stats:
+        out["memory.hbm_bytes_limit"] = float(stats["bytes_limit"])
+    return out
+
+
+def engine_collector(reg: MetricsRegistry) -> None:
+    """The engine-tier pull hook (``register_collector`` style, like the
+    codec copy-stats bridge): runs at every snapshot/scrape. Sums each
+    registered memory source's ``_memory_stats()`` into gauges, adds
+    backend HBM stats when available, and runs one compile-sentinel
+    sample so a scrape sees fresh ``engine.compiles.*`` gauges even
+    between ticks."""
+    totals: dict[str, float] = {}
+    with _MEMORY_LOCK:
+        sources = list(_MEMORY_SOURCES.values())
+    for obj in sources:
+        try:
+            stats = obj._memory_stats()
+        except Exception:  # noqa: BLE001 — one sick source must not kill scrape
+            continue
+        for k, v in stats.items():
+            totals[k] = totals.get(k, 0.0) + float(v)
+    totals.update(_device_memory_stats())
+    for k, v in totals.items():
+        reg.set_gauge(k, v)
+    # Gauges whose every source retired since the last pass (a closed
+    # paged batcher's pool gauges, a vanished draft cache) are removed,
+    # not served stale forever.
+    with _MEMORY_LOCK:
+        stale = _MEMORY_WRITTEN.get(reg, set()) - set(totals)
+        _MEMORY_WRITTEN[reg] = set(totals)
+    for k in stale:
+        reg.remove_gauge(k)
+    _SENTINEL.sample(reg)
+
+
+# Pull-side default: the process registry scrapes engine state without
+# any component having to push (the exporter re-registers this on
+# whichever registry it actually serves; register_collector is
+# idempotent per function object).
+global_metrics().register_collector(engine_collector)
+
+
+# -- tick-phase timing ------------------------------------------------------
+
+
+class EngineObs:
+    """Process-global gate for per-phase engine timing.
+
+    ``enabled`` is the one branch every phase site pays when off (the
+    ``obs_timeline`` pattern). On, :meth:`phase` records one
+    ``engine.phase.<name>_s`` histogram sample (one registry-lock hold)
+    and, when the global tracer is enabled, an ``engine.<name>`` span —
+    so tick phases land in the same Perfetto timeline as the request
+    spans. Enable via ``ObservabilityConfig(obs_engine=True)`` (applied
+    when a Dispatcher is constructed) or directly:
+    ``global_engine_obs().enabled = True``."""
+
+    __slots__ = ("enabled",)
+
+    def __init__(self):
+        self.enabled = False
+
+    @staticmethod
+    def now() -> float:
+        return time.perf_counter()
+
+    def phase(
+        self, name: str, t0: float, *, span: bool = True, **attrs
+    ) -> float:
+        """Close phase ``name`` opened at ``t0``; returns the close time
+        (the next phase's open). ``span=False`` for sites that already
+        record their own tracer span (``LocalPipeline``'s stage/hop)."""
+        t1 = time.perf_counter()
+        global_metrics().observe(f"engine.phase.{name}_s", t1 - t0)
+        if span:
+            tracer = global_tracer()
+            if tracer.enabled:
+                tracer.add_span(
+                    f"engine.{name}", start=t0, end=t1, **attrs
+                )
+        return t1
+
+
+_ENGINE_OBS = EngineObs()
+
+
+def global_engine_obs() -> EngineObs:
+    return _ENGINE_OBS

@@ -150,6 +150,23 @@ class Tracer:
         """The clock spans are recorded on (``time.perf_counter``)."""
         return time.perf_counter()
 
+    def instant(self, name: str, **attrs) -> None:
+        """Record a zero-duration marker event (e.g. the compile
+        sentinel's ``engine.recompile``) — it renders in Perfetto as a
+        point on the timeline next to the tick that paid for it."""
+        if not self.enabled:
+            return
+        t = time.perf_counter()
+        self._record(
+            Span(
+                name=name,
+                start=t,
+                end=t,
+                attrs=attrs,
+                tid=threading.get_ident(),
+            )
+        )
+
     def ingest(self, exported: list[dict]) -> None:
         """Merge spans exported by ANOTHER process (:func:`export_spans`
         dicts: wall-clock times + origin pid/tid) into this ring. Times

@@ -1,21 +1,27 @@
 """Observability overhead on the steady-state decode tick.
 
-The instrumentation contract (ISSUE 2): request timelines + tracing must
-be cheap enough to leave on. Disabled, the only residue is one branch
-per site (``obs_timeline`` False + tracer off == pre-PR tick); enabled,
-the budget is < 5% added tick wall time on CPU.
+The instrumentation contract (ISSUE 2, extended by the engine tier):
+request timelines, engine phase timing and tracing must be cheap enough
+to leave on. Disabled, the only residue is one branch per site
+(``obs_timeline`` False + ``obs_engine`` off + tracer off == pre-PR
+tick); enabled, the budget is < 5% added tick wall time on CPU.
 
-Three configurations over the SAME ContinuousBatcher steady state
+Four configurations over the SAME ContinuousBatcher steady state
 (all slots decoding, no admissions, chunked ticks):
 
-- ``off``     — ``obs_timeline=False``, tracer disabled (the floor).
+- ``off``     — ``obs_timeline=False``, engine obs off, tracer disabled
+  (the floor; the always-on compile-sentinel sample per tick is part of
+  this floor by design).
 - ``timeline``— default serving config: TTFT/ITL/queue-wait histograms
-  + flight-recorder lifecycle events (tracer still off).
-- ``trace``   — timeline + the span ring (prefill/decode-chunk spans).
+  + flight-recorder lifecycle events (engine + tracer still off).
+- ``engine``  — timeline + ``obs_engine`` per-phase histograms
+  (``engine.phase.{admit,prefill,decode,commit,update}_s``).
+- ``trace``   — engine + the span ring (prefill/decode-chunk spans).
 
-One JSON line: value = enabled ("trace") overhead vs the floor in
+One JSON line: value = fully-enabled ("trace") overhead vs the floor in
 percent; ``vs_baseline`` = the 5% budget minus the measured overhead
-(positive = within budget). Per-config per-tick means ride in extras.
+(positive = within budget). Per-config per-tick means and the
+engine-only overhead ride in extras.
 
 Timing note (benchmarks/common.py): ticks end in a real host fetch of
 the chunk's tokens, so the region is honestly bounded per tick.
@@ -54,11 +60,13 @@ def main() -> int:
         from adapt_tpu.runtime.continuous import ContinuousBatcher
         from adapt_tpu.utils.tracing import global_tracer
 
+        from adapt_tpu.utils.profiling import global_engine_obs
+
         chunk = 8
-        # Requests must OUTLIVE every measured window (warmup + 3
+        # Requests must OUTLIVE every measured window (warmup + 4
         # configs x trials x n_ticks), or late ticks measure an idle
         # batcher: size max_len from the measurement plan.
-        total_ticks = n_ticks * (3 * trials + 1) + 8
+        total_ticks = n_ticks * (4 * trials + 1) + 8
         steps = total_ticks * chunk
         lm = lm_tiny(vocab=37, max_len=steps + 16)
         variables = lm.graph.init(
@@ -72,13 +80,15 @@ def main() -> int:
         bat.tick()
 
         tracer = global_tracer()
+        eobs = global_engine_obs()
         for _ in range(n_ticks):  # warm caches before ANY timed window
             bat.tick()
 
-        configs = {  # name -> (obs_timeline, tracer.enabled)
-            "off": (False, False),
-            "timeline": (True, False),
-            "trace": (True, True),
+        configs = {  # name -> (obs_timeline, obs_engine, tracer.enabled)
+            "off": (False, False, False),
+            "timeline": (True, False, False),
+            "engine": (True, True, False),
+            "trace": (True, True, True),
         }
         best = {name: float("inf") for name in configs}
         # Round-robin trials + best-of, ROTATING the config order each
@@ -86,10 +96,12 @@ def main() -> int:
         # attention window), so a fixed order would hand the
         # first-measured config the cheapest positions every trial.
         names = list(configs)
+        n = len(names)
         for t in range(trials):
-            for name in names[t % 3:] + names[: t % 3]:
-                timeline, trace = configs[name]
+            for name in names[t % n:] + names[: t % n]:
+                timeline, engine, trace = configs[name]
                 bat.obs_timeline = timeline
+                eobs.enabled = engine
                 tracer.enabled = trace
                 t0 = time.perf_counter()
                 for _ in range(n_ticks):
@@ -97,10 +109,11 @@ def main() -> int:
                 best[name] = min(
                     best[name], (time.perf_counter() - t0) / n_ticks
                 )
-        t_off, t_timeline, t_trace = (
-            best["off"], best["timeline"], best["trace"]
+        t_off, t_timeline, t_engine, t_trace = (
+            best["off"], best["timeline"], best["engine"], best["trace"]
         )
         tracer.enabled = False
+        eobs.enabled = False
         still_active = bat.stats()["active"]
         if still_active != slots:
             raise RuntimeError(
@@ -111,13 +124,15 @@ def main() -> int:
         emit(
             "micro_obs_overhead_pct",
             overhead_pct,
-            "% tick wall time (trace+timeline vs off)",
+            "% tick wall time (trace+engine+timeline vs off)",
             BUDGET_PCT - overhead_pct,
             budget_pct=BUDGET_PCT,
             tick_off_ms=round(t_off * 1e3, 4),
             tick_timeline_ms=round(t_timeline * 1e3, 4),
+            tick_engine_ms=round(t_engine * 1e3, 4),
             tick_trace_ms=round(t_trace * 1e3, 4),
             timeline_only_pct=round((t_timeline / t_off - 1.0) * 100.0, 3),
+            engine_pct=round((t_engine / t_off - 1.0) * 100.0, 3),
             slots=slots,
             ticks=n_ticks,
             trials=trials,
@@ -126,7 +141,7 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 — always one JSON line, rc 0
         emit(
             "micro_obs_overhead_pct", 0.0,
-            "% tick wall time (trace+timeline vs off)", 0.0,
+            "% tick wall time (trace+engine+timeline vs off)", 0.0,
             error=str(e)[-300:],
         )
     return 0

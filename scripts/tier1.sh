@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Tier-1 verify wrapper: runs the ROADMAP.md tier-1 command VERBATIM
+# (kept in one place so docs, CI and humans stop copy-pasting it), then
+# optionally the perf-regression gate.
+#
+# Usage:
+#   scripts/tier1.sh           # tier-1 tests only (exit = pytest rc)
+#   scripts/tier1.sh --gate    # tests, then benchmarks/ci_gate.py
+#                              # against benchmarks/baselines/seed.json
+#
+# The gate is opt-in because it runs the micro-benchmark suite (a few
+# minutes of CPU) and its wall-clock metrics want an otherwise idle
+# machine; the tests alone are the mandatory bar.
+
+set -u
+cd "$(dirname "$0")/.."
+
+GATE=0
+for a in "$@"; do
+  [ "$a" = "--gate" ] && GATE=1
+done
+
+# ROADMAP.md "Tier-1 verify" — verbatim (it ends in `exit $rc`, so it
+# runs in a subshell and its exit status is captured here).
+bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "tier1.sh: tier-1 tests FAILED (rc=$rc)" >&2
+  exit "$rc"
+fi
+
+if [ "$GATE" = "1" ]; then
+  echo "tier1.sh: running perf-regression gate" >&2
+  python benchmarks/ci_gate.py --baseline benchmarks/baselines/seed.json
+  rc=$?
+  if [ "$rc" -ne 0 ]; then
+    echo "tier1.sh: perf gate FAILED (rc=$rc)" >&2
+    exit "$rc"
+  fi
+fi
+exit 0

@@ -14,7 +14,6 @@ import numpy as np
 import pytest
 
 from adapt_tpu.config import SpeculativeConfig
-from adapt_tpu.models.speculative import draft_chunk
 from adapt_tpu.models.transformer_lm import (
     generate,
     lm_tiny,
@@ -200,6 +199,7 @@ def test_spec_tick_fixed_shape_zero_h2d_and_observability(
     in the registry, decode.draft / decode.verify spans in the tracer
     tagged with the tick's request ids."""
     from adapt_tpu.utils.metrics import global_metrics
+    from adapt_tpu.utils.profiling import global_compile_sentinel
     from adapt_tpu.utils.tracing import global_tracer
 
     lm, variables = lm_setup
@@ -209,10 +209,19 @@ def test_spec_tick_fixed_shape_zero_h2d_and_observability(
     was_enabled = tracer.enabled
     tracer.enabled = True
     try:
-        verify_before = ContinuousBatcher._spec_verify._cache_size()
+        # The two-program guard is expressed through the compile
+        # sentinel's PUBLIC API (utils.profiling): constructing the
+        # batcher registers both decode programs (and re-arms their
+        # warmup); compiles() reads the watched jit cache sizes — no
+        # raw _cache_size() poking.
+        sentinel = global_compile_sentinel()
         bat = ContinuousBatcher(
             lm, variables, slots=2, draft_lm=draft, draft_variables=dvars,
         )
+        assert {
+            "continuous.spec_verify", "speculative.draft_chunk"
+        } <= set(sentinel.watched())
+        verify_before = sentinel.compiles("continuous.spec_verify")
         r1 = bat.submit(np.asarray([1, 2, 3], np.int32), 40)
         bat.tick()  # admission + first round compiles both programs
         # Exactly ONE verify variant for this batcher (self is the jit
@@ -220,11 +229,11 @@ def test_spec_tick_fixed_shape_zero_h2d_and_observability(
         # identically-shaped earlier batcher — the draft scan is shared
         # across instances by design, its own fixed-shape evidence).
         assert (
-            ContinuousBatcher._spec_verify._cache_size() - verify_before
+            sentinel.compiles("continuous.spec_verify") - verify_before
             == 1
         )
-        draft_entries = draft_chunk._cache_size()
-        verify_entries = ContinuousBatcher._spec_verify._cache_size()
+        draft_entries = sentinel.compiles("speculative.draft_chunk")
+        verify_entries = sentinel.compiles("continuous.spec_verify")
         before = bat.stats()["h2d_transfers"]
         for _ in range(4):
             bat.tick()  # pure steady state: desynchronized acceptance
@@ -238,9 +247,9 @@ def test_spec_tick_fixed_shape_zero_h2d_and_observability(
         r3 = bat.submit(np.asarray([9, 9, 9, 9, 9], np.int32), 6)
         out.update(bat.run())
         assert set(out) == {r1, r2, r3}
-        assert draft_chunk._cache_size() == draft_entries
+        assert sentinel.compiles("speculative.draft_chunk") == draft_entries
         assert (
-            ContinuousBatcher._spec_verify._cache_size() == verify_entries
+            sentinel.compiles("continuous.spec_verify") == verify_entries
         )
         snap = global_metrics().snapshot()
         assert "continuous.spec_acceptance" in snap["gauges"]
