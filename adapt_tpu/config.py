@@ -108,6 +108,35 @@ class PipelineConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Intra-model tensor parallelism for the serving tier
+    (``runtime/continuous`` + ``parallel/sharding.lm_tp_rules``).
+
+    ``tp > 1`` makes the continuous batcher MESH-NATIVE: transformer-LM
+    weights place by the megatron-style rules (qkv / mlp-in column-split
+    over the ``axis`` mesh axis, attn-out / mlp-out row-split — exactly
+    one psum pair per block), and the KV caches (dense slot strips or
+    paged pools) shard on their HEAD axis, so per-device KV bytes are
+    the logical bytes / tp. Page *tables*, the device-resident sampling
+    state and the draft model stay replicated — admission/commit logic
+    is sharding-blind. See ``docs/SERVING.md`` "Tensor-parallel
+    serving"."""
+
+    #: Mesh size along ``axis``: each block's heads, KV heads, model dim
+    #: and MLP hidden must divide by it
+    #: (``models.transformer_lm.validate_tp``).
+    tp: int = 1
+    #: Mesh axis name the splits land on.
+    axis: str = "tp"
+
+    def __post_init__(self):
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {self.tp}")
+        if not self.axis:
+            raise ValueError("axis must be a non-empty mesh axis name")
+
+
+@dataclasses.dataclass(frozen=True)
 class SpeculativeConfig:
     """Batched speculative decoding knobs (``runtime/continuous``
     speculative mode; ``docs/SERVING.md`` §5).
@@ -205,4 +234,7 @@ class ServeConfig:
     )
     spec: SpeculativeConfig = dataclasses.field(
         default_factory=SpeculativeConfig
+    )
+    parallel: ParallelConfig = dataclasses.field(
+        default_factory=ParallelConfig
     )

@@ -765,6 +765,34 @@ def lm_tiny(vocab: int = 256, max_len: int = 64) -> TransformerLM:
     return transformer_lm(vocab, 64, 4, 4, 128, max_len, name="lm_tiny")
 
 
+def validate_tp(lm: TransformerLM, tp: int) -> None:
+    """Eager divisibility checks for megatron-style tensor parallelism
+    (``parallel.sharding.lm_tp_rules`` placement + head-sharded KV
+    caches): every decoder block's query heads, KV/cache heads, model
+    dim and MLP hidden must divide by ``tp``, or the column/row splits
+    (and the cache's head-axis sharding) cannot land evenly. Raises a
+    named ValueError instead of an opaque device_put/GSPMD error. The
+    ``cache_heads`` check is the GQA-aware one: KV heads shard over tp,
+    so kv_heads % tp == 0 keeps each shard's query-head groups aligned
+    with its own resident KV heads (collective-free attention)."""
+    if tp <= 1:
+        return
+    for name in lm.block_names:
+        block = lm.graph.node(name).module
+        for what, n in (
+            ("heads", block.heads),
+            ("cache (KV) heads", block.cache_heads),
+            ("model dim", block.dim),
+            ("mlp hidden dim", block.mlp_dim),
+        ):
+            if n % tp:
+                raise ValueError(
+                    f"{name}: {what} {n} not divisible by tp={tp} — "
+                    "megatron TP splits heads/KV-heads column-wise and "
+                    "dim/mlp row-wise, all must divide evenly"
+                )
+
+
 def nucleus_filter(lg: jax.Array, top_p: jax.Array) -> jax.Array:
     """Top-p (nucleus) truncation with a TRACED p: keep the smallest
     descending-probability prefix whose mass reaches ``top_p`` (the

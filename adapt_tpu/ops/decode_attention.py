@@ -77,6 +77,25 @@ DECODE_BLOCK_K = 1024
 _MIN_NATIVE_BLOCK_K = 256
 
 
+def check_head_parity(q_heads: int, cache_heads: int) -> None:
+    """Every decode/verify primitive derives its grid, GQA fold and
+    block sizes from the head count of the operands it is GIVEN — which
+    under tensor parallelism is the PER-SHARD count (kv_heads / tp
+    inside a shard_map body; the global count under GSPMD, where the
+    partitioner divides it). The one mistake that silently breaks this
+    is mixing a sharded cache with globally-shaped queries (or vice
+    versa) across a partial TP migration: the einsums would
+    broadcast-fail deep inside XLA. Fail here, by name, instead."""
+    if q_heads != cache_heads:
+        raise ValueError(
+            f"q carries {q_heads} KV-head rows but the cache carries "
+            f"{cache_heads}: both operands must use the same (per-shard) "
+            "head count — under tensor parallelism shard queries and "
+            "caches together (runtime/continuous shards both on the "
+            "head axis)"
+        )
+
+
 def default_block_k(cache_len: int, quantized: bool) -> int:
     """Largest supported cache block for this (cache_len, dtype):
     quantized caches are pinned to the scale-tile block; native caches
@@ -323,6 +342,7 @@ def verify_attention(q, cache_k, cache_v, index, chunk: int, window=None):
     now (``decode_kernel_wins`` rules the streaming kernel out
     everywhere until its hardware A/B lands, and verify amortizes the
     cache stream over K rows already)."""
+    check_head_parity(q.shape[1], cache_k.shape[1])
     sm = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
     s = (
         jnp.einsum(
@@ -425,8 +445,14 @@ def decode_attention(
     (falls back to the oracle off-pallas or when L doesn't divide into
     supported blocks: native caches need L % 256 == 0, int8 caches
     L % 1024 == 0 — the scale-tile layout). ``block_k`` None picks the
-    largest supported block (``default_block_k``)."""
+    largest supported block (``default_block_k``). Every grid/fold/block
+    derives from the shapes GIVEN — the per-shard head count under
+    tensor parallelism — so a q/cache head mismatch fails loud
+    (``check_head_parity``)."""
     quantized = isinstance(cache_k, tuple)
+    check_head_parity(
+        q.shape[1], (cache_k[0] if quantized else cache_k).shape[1]
+    )
     cache_len = (cache_k[0] if quantized else cache_k).shape[2]
     if block_k is None:
         block_k = default_block_k(cache_len, quantized)

@@ -48,7 +48,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from adapt_tpu.ops.decode_attention import _decode_kernel
+from adapt_tpu.ops.decode_attention import _decode_kernel, check_head_parity
 
 try:
     from jax.experimental.pallas import tpu as pltpu
@@ -369,6 +369,7 @@ def paged_chunk_attention(
     is fine — those positions are past every row's mask). Dispatch as
     :func:`paged_attention`: kernel on real TPUs with lane-multiple
     pages, oracle elsewhere."""
+    check_head_parity(q.shape[1], k_pool.shape[1])
     page = k_pool.shape[2]
     supported = pltpu is not None and page % 128 == 0
     if prefer is None:
@@ -560,7 +561,11 @@ def paged_verify_attention(
     Dispatch as :func:`paged_attention`: the scalar-prefetch kernel on
     a real TPU with lane-multiple pages (the gather oracle materializes
     every slot's whole window — the traffic paging exists to avoid),
-    the oracle everywhere else."""
+    the oracle everywhere else. Grids and the GQA fold derive from the
+    shapes given — the per-shard head count under tensor parallelism —
+    so q and pool must carry the same head count
+    (``decode_attention.check_head_parity``)."""
+    check_head_parity(q.shape[1], k_pool.shape[1])
     page = k_pool.shape[2]
     supported = pltpu is not None and page % 128 == 0
     if prefer is None:
@@ -598,7 +603,10 @@ def paged_attention(
     window, the exact traffic paging exists to avoid), the oracle
     everywhere else (off-TPU the kernel only has the Pallas INTERPRETER,
     orders of magnitude slower than XLA's gather — tests opt in with
-    ``prefer="pallas"``). ``"pallas"`` / ``"xla"`` force."""
+    ``prefer="pallas"``). ``"pallas"`` / ``"xla"`` force. Grids/folds
+    derive from the given (per-shard, under TP) head count — q and pool
+    must agree (``decode_attention.check_head_parity``)."""
+    check_head_parity(q.shape[1], k_pool.shape[1])
     page = k_pool.shape[2]
     supported = pltpu is not None and page % 128 == 0
     if prefer is None:

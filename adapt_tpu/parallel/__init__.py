@@ -11,8 +11,11 @@ from adapt_tpu.parallel.ring_attention import (
 from adapt_tpu.parallel.ulysses import ulysses_attention
 from adapt_tpu.parallel.sharding import (
     batch_sharding,
+    lm_tp_rules,
+    merge_specs,
     replicate,
     shard_batch,
+    tree_shardings,
     vit_tp_rules,
 )
 
@@ -26,7 +29,10 @@ __all__ = [
     "unstripe_sequence",
     "ulysses_attention",
     "batch_sharding",
+    "lm_tp_rules",
+    "merge_specs",
     "replicate",
     "shard_batch",
+    "tree_shardings",
     "vit_tp_rules",
 ]

@@ -3,7 +3,11 @@
 The reference has no intra-model parallelism at all (SURVEY.md §2.2: PP
 only). TPU-native, DP/TP are nearly free via GSPMD: annotate batch and
 weight shardings over a mesh and let XLA insert the collectives (the
-scaling-book recipe). These helpers centralize the annotations.
+scaling-book recipe). These helpers centralize the annotations — the
+Mesh-TensorFlow discipline of expressing the layout ONCE: path-pattern
+rules map a param tree to PartitionSpecs (``vit_tp_rules`` for the ViT
+encoder, ``lm_tp_rules`` for the transformer-LM serving tier), and
+``merge_specs`` composes orthogonal placements (EP x TP) for one param.
 """
 
 from __future__ import annotations
@@ -55,6 +59,82 @@ def vit_tp_rules(path: str, value_ndim: int) -> P:
             if len(spec) == value_ndim:
                 return P(*spec)
     return P()
+
+
+#: Tensor-parallel placement rules for the transformer-LM decoder blocks
+#: (``models/transformer_lm.py``) — the serving-tier counterpart of
+#: ``_VIT_TP_PATTERNS``, megatron-style so each block costs exactly ONE
+#: psum pair per token (attn-out + mlp-out row splits; everything before
+#: them column-splits and needs no collective):
+#:
+#: - fused MHA ``attn/qkv`` ((d, 3, heads, hd) DenseGeneral): the heads
+#:   axis is the column split — each shard projects heads/tp query AND
+#:   KV heads, so the KV cache head axis shards with it;
+#: - GQA ``attn/q`` ((d, heads, hd)) / ``attn/kv`` ((d, 2, kv_heads,
+#:   hd)): both head axes split over tp — kv_heads % tp == 0 keeps every
+#:   shard's query-head groups aligned with its own KV heads (adjacent
+#:   groups, the ``_group_q`` fold), so GQA attention stays collective-
+#:   free;
+#: - ``attn/out`` ((heads*hd, d)): row split on the contracted axis —
+#:   the block's first psum;
+#: - dense MLP ``mlp_in`` column / ``mlp_out`` row — the second psum;
+#: - MoE experts ``moe/w1`` ((E, d, hidden)) / ``moe/w2`` ((E, hidden,
+#:   d)): the HIDDEN axis splits over tp, the leading expert axis is
+#:   deliberately left unsharded so these specs compose with
+#:   ``parallel/expert.py``'s ``ep`` placement (``merge_specs``); the
+#:   router ``gate`` replicates;
+#: - ``head/logits`` ((d, vocab)): row split on the contracted model dim
+#:   (one final psum; logits come out replicated, so sampling/argmax is
+#:   sharding-blind). Embeddings, LayerNorms and out/mlp_out biases
+#:   replicate (biases add after the psum).
+_LM_TP_PATTERNS: list[tuple[str, tuple]] = [
+    (r"decoder_block.*attn/qkv/kernel", (None, None, "tp", None)),
+    (r"decoder_block.*attn/qkv/bias", (None, "tp", None)),
+    (r"decoder_block.*attn/q/kernel", (None, "tp", None)),
+    (r"decoder_block.*attn/q/bias", ("tp", None)),
+    (r"decoder_block.*attn/kv/kernel", (None, None, "tp", None)),
+    (r"decoder_block.*attn/kv/bias", (None, "tp", None)),
+    (r"decoder_block.*attn/out/kernel", ("tp", None)),
+    (r"decoder_block.*mlp_in/kernel", (None, "tp")),
+    (r"decoder_block.*mlp_in/bias", ("tp",)),
+    (r"decoder_block.*mlp_out/kernel", ("tp", None)),
+    (r"decoder_block.*moe/w1", (None, None, "tp")),
+    (r"decoder_block.*moe/b1", (None, "tp")),
+    (r"decoder_block.*moe/w2", (None, "tp", None)),
+    (r"head.*logits/kernel", ("tp", None)),
+]
+
+
+def lm_tp_rules(path: str, value_ndim: int, axis: str = "tp") -> P:
+    """Map a flattened transformer-LM param path to its TP PartitionSpec
+    (default: replicated). ``axis`` renames the mesh axis the splits
+    land on (``config.ParallelConfig.axis``)."""
+    for pattern, spec in _LM_TP_PATTERNS:
+        if re.fullmatch(pattern, path):
+            if len(spec) == value_ndim:
+                return P(*(axis if s == "tp" else s for s in spec))
+    return P()
+
+
+def merge_specs(a: P, b: P) -> P:
+    """Compose two PartitionSpecs for ONE param — e.g. an MoE expert
+    weight's ``ep`` placement (``parallel/expert.py``: leading expert
+    axis) with its ``tp`` placement (``lm_tp_rules``: hidden axis) into
+    ``P('ep', None, 'tp')``. Each dim takes whichever spec shards it;
+    both sharding the same dim onto different axes is a conflict and
+    raises."""
+    n = max(len(a), len(b))
+    out = []
+    for i in range(n):
+        ax_a = a[i] if i < len(a) else None
+        ax_b = b[i] if i < len(b) else None
+        if ax_a is not None and ax_b is not None and ax_a != ax_b:
+            raise ValueError(
+                f"specs conflict on dim {i}: {a} vs {b} "
+                f"({ax_a!r} != {ax_b!r})"
+            )
+        out.append(ax_a if ax_a is not None else ax_b)
+    return P(*out)
 
 
 def tree_shardings(

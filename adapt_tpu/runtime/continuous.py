@@ -137,8 +137,30 @@ tail check — the emitted prefix still equals solo ``generate()``), and
 the next commit boundary with its partial stream as the result (slot
 and pages free immediately after).
 
-Not in scope (v1): cross-chip slots (compose with the pipelined
-decoders for models bigger than one chip).
+**Tensor-parallel serving** (``mesh=`` + ``config.ParallelConfig{tp}``;
+``docs/SERVING.md`` "Tensor-parallel serving"): the whole request tier
+runs SPMD over a mesh's ``tp`` axis. Weights place by the megatron-style
+rules in ``parallel/sharding.lm_tp_rules`` (qkv/mlp-in column-split,
+attn-out/mlp-out row-split — exactly ONE psum pair per block per token,
+so the decode tick's latency does not drown in ICI hops), and the KV
+caches — dense slot strips and paged pools alike — shard on their HEAD
+axis (GQA-aware: kv_heads % tp == 0), so per-device KV bytes are the
+logical bytes / tp: models whose weights + KV exceed one chip's HBM
+serve, and models that fit stop leaving N-1 chips idle. Everything the
+host touches stays REPLICATED — page tables, the device-resident
+sampling state, staged admission vectors — so admission, commit, cancel,
+prefix caching and the pager are sharding-blind, and all the hot-path
+invariants survive unchanged and re-pinned by tests: zero host arrays
+per steady-state tick, the two-program compile footprint, buffer
+donation, and per-row greedy losslessness vs single-device
+``generate()`` on both layouts including speculative mode (the draft
+model deliberately replicates — it is small by construction and a
+replicated draft scan is collective-free). ``stats()`` reports
+``cache_bytes`` (logical) next to ``cache_bytes_per_device``; the
+``memory.*_per_device`` gauges mirror it at scrape.
+
+Not in scope (v1): pipeline-parallel slots (compose with the pipelined
+decoders for models bigger than a TP group).
 """
 
 from __future__ import annotations
@@ -156,19 +178,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from adapt_tpu.config import SpeculativeConfig
+from adapt_tpu.config import ParallelConfig, SpeculativeConfig
 from adapt_tpu.models.speculative import accept_speculation, draft_chunk
 from adapt_tpu.models.transformer_lm import (
     TransformerLM,
     chosen_logprob,
     nucleus_filter,
+    validate_tp,
 )
+from adapt_tpu.parallel.sharding import lm_tp_rules, tree_shardings
 from adapt_tpu.runtime.paged import Pager, insert_prefill_pages
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
 from adapt_tpu.utils.profiling import (
     aggregate_size_fn,
+    device_local_nbytes,
     global_compile_sentinel,
     global_engine_obs,
     register_memory_source,
@@ -242,7 +268,10 @@ class _Slot:
 
 
 class ContinuousBatcher:
-    """Slot-based continuous batching over one LM on one device.
+    """Slot-based continuous batching over one LM — on one device, or
+    tensor-parallel over a mesh's ``tp`` axis (``mesh=`` +
+    ``config.ParallelConfig``; weights and KV head-sharded, control
+    plane replicated — see the module docstring).
 
     ``slots`` is the lockstep decode width (static); ``top_k`` here is
     only the DEFAULT for requests that do not pass their own (per-row
@@ -269,8 +298,77 @@ class ContinuousBatcher:
         draft_lm: TransformerLM | None = None,
         draft_variables=None,
         speculative: SpeculativeConfig | None = None,
+        mesh: Mesh | None = None,
+        parallel: ParallelConfig | None = None,
     ):
         self.lm = lm
+        # -- tensor parallelism (mesh-native serving) ----------------------
+        # ``mesh`` + ``config.ParallelConfig{tp}`` shard the serving tier
+        # over the mesh's tp axis: variables place by the megatron rules
+        # (parallel.sharding.lm_tp_rules — one psum pair per block), KV
+        # caches/pools shard on their HEAD axis (per-device KV bytes ==
+        # logical / tp), and every jitted program compiles under GSPMD
+        # with explicit cache shardings, so the collectives are inserted
+        # by the compiler — the host-side admission/commit logic below
+        # is sharding-blind (page tables and _dstate stay replicated).
+        if parallel is not None and parallel.tp > 1 and mesh is None:
+            raise ValueError(
+                f"ParallelConfig(tp={parallel.tp}) requires a mesh"
+            )
+        self._mesh = mesh
+        if mesh is not None:
+            axis = (parallel or ParallelConfig()).axis
+            if axis not in mesh.shape:
+                raise ValueError(
+                    f"mesh has no {axis!r} axis (axes: "
+                    f"{tuple(mesh.axis_names)})"
+                )
+            tp = int(mesh.shape[axis])
+            if parallel is not None and parallel.tp != tp:
+                raise ValueError(
+                    f"ParallelConfig.tp={parallel.tp} != mesh {axis!r} "
+                    f"size {tp}"
+                )
+            validate_tp(lm, tp)
+            self._tp = tp
+            if tp == 1:
+                # Degenerate mesh: a size-1 tp axis partitions nothing,
+                # and 1-device meshes are where jax's sharding
+                # normalization is quirkiest — XLA hands back
+                # equivalent-but-UNEQUAL NamedShardings (P() vs
+                # P(None, 'tp', None)) for physically identical
+                # outputs, and every flip is a phantom jit variant in
+                # the next consumer. Run the ordinary single-device
+                # path instead: same program, no GSPMD, exact
+                # compile-count parity with the no-mesh batcher (the
+                # tp=1 column of benchmarks/micro/tp_decode.py is this
+                # path). The local too: every placement site below
+                # branches on it.
+                mesh = None
+                self._mesh = None
+                self._repl = None
+                self._kv_sharding = None
+            else:
+                #: Replicated placement for everything the host stages
+                #: (prompt ids, fused admission vectors, page tables,
+                #: _dstate) — admission/commit logic is sharding-blind.
+                self._repl = NamedSharding(mesh, P())
+                #: KV caches shard on the HEAD axis (dim 1 of both the
+                #: dense (slots, kvh, L, hd) strips and the paged
+                #: (pages, kvh, P, hd) pools — and of the int8 scale
+                #: planes).
+                self._kv_sharding = NamedSharding(mesh, P(None, axis))
+                variables = jax.device_put(
+                    variables,
+                    tree_shardings(
+                        variables, mesh,
+                        rules=partial(lm_tp_rules, axis=axis),
+                    ),
+                )
+        else:
+            self._tp = 1
+            self._repl = None
+            self._kv_sharding = None
         self.variables = variables
         self.slots = [_Slot(idx=i) for i in range(slots)]
         self.top_k = top_k
@@ -413,6 +511,10 @@ class ContinuousBatcher:
                 )
 
         self._caches = [(one_cache(), one_cache()) for _ in lm.block_names]
+        if mesh is not None:
+            # Head-sharded KV: each device holds kv_heads / tp of every
+            # slot strip (or pool page) — THE capacity win TP buys.
+            self._caches = jax.device_put(self._caches, self._kv_sharding)
         #: Idle-row cache position: slot layout parks garbage writes at
         #: the trash strip; paged layout uses a negative sentinel that
         #: stays negative across a whole tick's position advance
@@ -443,6 +545,19 @@ class ContinuousBatcher:
                 (draft_cache(), draft_cache())
                 for _ in draft_lm.block_names
             ]
+            if mesh is not None:
+                # The DRAFT stays fully replicated: it is small by
+                # construction (sharding it buys HBM that is not the
+                # bottleneck and would force its head counts to divide
+                # tp), and a replicated draft scan is collective-free —
+                # the spec tick's ICI budget goes to the target's one
+                # psum pair per block.
+                self._draft_variables = jax.device_put(
+                    draft_variables, self._repl
+                )
+                self._draft_caches = jax.device_put(
+                    self._draft_caches, self._repl
+                )
         else:
             self._draft_caches = None
         #: Speculation lifetime counters (instance-scoped, like the
@@ -475,6 +590,11 @@ class ContinuousBatcher:
             # re-parking idle rows at the sentinel every chunk
             "active": jnp.zeros((slots,), bool),
         }
+        if mesh is not None:
+            # Per-slot sampling state replicates: it is O(slots) scalars
+            # — sharding it would trade nothing for collectives in the
+            # setters.
+            self._dstate = jax.device_put(self._dstate, self._repl)
         #: Device copy of the pager's page table, re-uploaded only when
         #: the host table actually changed (admission/retirement/window
         #: recycling) — a steady-state paged tick stages nothing.
@@ -565,9 +685,44 @@ class ContinuousBatcher:
         """The ONE host->device staging funnel for this module: counts
         every transfer so tests and benchmarks/micro can assert the
         fused-staging contract (0 per steady tick, O(1) per admission)
-        instead of trusting docstrings."""
+        instead of trusting docstrings. Under a mesh, staged arrays are
+        placed REPLICATED explicitly (a one-device-committed array mixed
+        into a sharded program would force GSPMD reshards); one logical
+        transfer either way."""
         self._h2d_count += 1
+        if self._mesh is not None:
+            return jax.device_put(x, self._repl)
         return jnp.asarray(x)
+
+    def _shard_kv(self, caches):
+        """Explicit in/out cache sharding for the compiled programs:
+        pin every KV leaf (dense strips, pools, int8 scale planes) to
+        the head-axis sharding so GSPMD partitions the decode math and
+        inserts the block psums, instead of falling back to whatever
+        propagation guesses. No-mesh batchers pay one branch."""
+        if self._mesh is None:
+            return caches
+        return jax.tree.map(
+            lambda c: lax.with_sharding_constraint(c, self._kv_sharding),
+            caches,
+        )
+
+    def _repl_state(self, dstate):
+        """Explicit in/out sharding for the per-slot sampling state:
+        pinned REPLICATED through every donated program. Left to
+        propagation, GSPMD may pick different output shardings for the
+        pass-through leaves in different programs (observed: the key
+        schedules came back head-split from the verify program but
+        replicated from the admission setter), and a producer-to-
+        producer sharding flip is a phantom jit variant in every
+        consumer — the exact recompile class the sentinel exists to
+        catch."""
+        if self._mesh is None:
+            return dstate
+        return {
+            k: lax.with_sharding_constraint(x, self._repl)
+            for k, x in dstate.items()
+        }
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
     def _stage_slot(self, dstate, ints, floats, keys):
@@ -591,7 +746,7 @@ class ContinuousBatcher:
             dstate["keys"], keys[None], (i, 0, 0)
         )
         d["active"] = dstate["active"].at[i].set(True)
-        return d
+        return self._repl_state(d)
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
     def _clear_slot(self, dstate, slot):
@@ -608,7 +763,7 @@ class ContinuousBatcher:
         d["top_k"] = dstate["top_k"].at[slot].set(self.lm.vocab)
         d["top_p"] = dstate["top_p"].at[slot].set(1.0)
         d["active"] = dstate["active"].at[slot].set(False)
-        return d
+        return self._repl_state(d)
 
     def _truncate_rows(self, lg, top_ks):
         """Per-row top-k filter with a TRACED k: keep logits >= the k-th
@@ -656,6 +811,8 @@ class ContinuousBatcher:
         Returns ((chunk, B) emitted tokens, logprobs, caches, dstate);
         ONE host sync per call, not per token."""
         paged = table is not None
+        caches = self._shard_kv(caches)
+        dstate = self._repl_state(dstate)
         C = self.chunk
         temps = dstate["temp"]
         top_ks = dstate["top_k"]
@@ -728,7 +885,10 @@ class ContinuousBatcher:
         new["pos"] = jnp.where(active, dstate["pos"] + C, self._idle_pos)
         new["tok"] = jnp.where(active, toks[-1], 0)
         new["kbase"] = jnp.where(active, kbase + C, 0)
-        return toks, lps, list(caches), new
+        return (
+            toks, lps, self._shard_kv(list(caches)),
+            self._repl_state(new),
+        )
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
     def _spec_verify(self, variables, caches, dstate, dtoks, table=None):
@@ -750,6 +910,8 @@ class ContinuousBatcher:
         tokens, (d+1, B) logprobs, (B,) accepted counts, caches,
         dstate)."""
         paged = table is not None
+        caches = self._shard_kv(caches)
+        dstate = self._repl_state(dstate)
         d = self._spec_k
         tok, pos = dstate["tok"], dstate["pos"]
         active = dstate["active"]
@@ -799,8 +961,8 @@ class ContinuousBatcher:
             jnp.swapaxes(preds, 0, 1),
             jnp.swapaxes(lps, 0, 1),
             acc,
-            new_caches,
-            new,
+            self._shard_kv(new_caches),
+            self._repl_state(new),
         )
 
     def _insert_paged(self, caches, pages, kvs):
@@ -859,7 +1021,7 @@ class ContinuousBatcher:
                 h_last, variables, keys, floats[0], ints[1], floats[1],
                 floats[0] == 0.0, truncate, nucleus,
             )
-            return first, first_lp, kvs
+            return first, first_lp, self._shard_kv(kvs)
 
         self._prefill_cache[bucket] = prefill
         return prefill
@@ -893,6 +1055,7 @@ class ContinuousBatcher:
                  donate_argnums=(1,))
         def prefill(variables, caches, pages, ids, ints, floats, keys,
                     *, truncate, nucleus):
+            caches = self._shard_kv(caches)
             pos0 = ints[0]
             pos_ids = pos0 + jnp.arange(sbucket)[None]
             h = self._embed.apply(
@@ -907,6 +1070,7 @@ class ContinuousBatcher:
                     method="prefill_chunk_paged",
                 )
                 new_caches.append((kp, vp))
+            new_caches = self._shard_kv(new_caches)
             if not sample:  # mid-prefill pass: no token yet
                 return (jnp.zeros((1,), jnp.int32),
                         jnp.zeros((1,), jnp.float32), new_caches)
@@ -1828,10 +1992,19 @@ class ContinuousBatcher:
                 "h2d_transfers": self._h2d_count,
                 # Resident KV bytes across layouts (slot strips, int8
                 # value+scale pairs, or page pools) — the capacity number
-                # benches and dashboards report.
+                # benches and dashboards report. cache_bytes is the
+                # LOGICAL size; under tensor parallelism each device
+                # holds cache_bytes_per_device == cache_bytes / tp (the
+                # head axis shards), which is the number HBM planning
+                # must use.
                 "cache_bytes": sum(
                     x.nbytes for x in jax.tree.leaves(self._caches)
                 ),
+                "cache_bytes_per_device": sum(
+                    device_local_nbytes(x)
+                    for x in jax.tree.leaves(self._caches)
+                ),
+                "tp": self._tp,
             }
             if self._spec is not None:
                 out["spec_drafted"] = self._spec_drafted
@@ -1862,23 +2035,36 @@ class ContinuousBatcher:
         locks, tolerant of racing a live tick). Keys are final metric
         names; the collector SUMS across live batchers:
 
-        - dense layout: ``memory.kv_bytes`` (slot strip bytes, int8
-          value+scale pairs included);
-        - paged layout: ``memory.pool_bytes`` plus page occupancy —
+        - dense layout: ``memory.kv_bytes`` (LOGICAL slot strip bytes,
+          int8 value+scale pairs included) and
+          ``memory.kv_bytes_per_device`` (the per-chip resident bytes —
+          == kv_bytes / tp under a head-sharded mesh; equal otherwise);
+        - paged layout: ``memory.pool_bytes`` /
+          ``memory.pool_bytes_per_device`` (same logical-vs-per-chip
+          split) plus page occupancy —
           ``memory.pages_used + pages_free + pages_cached ==
           memory.pool_pages`` (allocatable pool, trash page excluded) —
           and the pager's prefix-cache effectiveness counters
           (``paged.prefix_{hits,misses,capacity_skips}``);
-        - speculative mode: ``memory.draft_cache_bytes``.
+        - speculative mode: ``memory.draft_cache_bytes`` (the draft
+          replicates under TP, so its per-device bytes ARE its logical
+          bytes).
         """
         cache_bytes = float(
             sum(x.nbytes for x in jax.tree.leaves(self._caches))
+        )
+        per_device = float(
+            sum(
+                device_local_nbytes(x)
+                for x in jax.tree.leaves(self._caches)
+            )
         )
         out: dict[str, float] = {}
         if self._paged:
             ps = self._pager.stats()
             out["memory.pool_bytes"] = cache_bytes
-            out["memory.pool_pages"] = float(ps.num_pages - 1)
+            out["memory.pool_bytes_per_device"] = per_device
+            out["memory.pool_pages"] = float(self._pager.num_allocatable)
             out["memory.pages_used"] = float(ps.in_use)
             out["memory.pages_cached"] = float(ps.cached)
             # PagerStats.free counts evictable cached pages as free
@@ -1891,6 +2077,7 @@ class ContinuousBatcher:
             )
         else:
             out["memory.kv_bytes"] = cache_bytes
+            out["memory.kv_bytes_per_device"] = per_device
         if self._draft_caches is not None:
             out["memory.draft_cache_bytes"] = float(
                 sum(x.nbytes for x in jax.tree.leaves(self._draft_caches))

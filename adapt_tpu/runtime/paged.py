@@ -25,6 +25,12 @@ Conventions (shared with ``ops/paged_attention``):
 - a slot's table row holds its pages in logical order; entries past its
   allocation point at the trash page.
 
+Tensor parallelism is invisible here by design: the POOLS shard on
+their head axis over the mesh (``runtime/continuous``), but a page is a
+page — the table, the free list, refcounts and prefix keys are logical
+bookkeeping, identical on every shard, so the allocator never changes
+with the mesh (``table()`` is uploaded replicated).
+
 No reference analog (SURVEY.md §2.2) — serving-memory frontier.
 """
 
@@ -84,6 +90,14 @@ class Pager:
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.prefix_capacity_skips = 0
+
+    @property
+    def num_allocatable(self) -> int:
+        """Pages the allocator can ever hand out: the pool minus the
+        reserved trash page — the denominator occupancy gauges and
+        capacity planning should use (``num_pages`` counts the trash
+        page too)."""
+        return self.num_pages - 1
 
     # -- raw pages ---------------------------------------------------------
 

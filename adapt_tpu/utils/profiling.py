@@ -29,7 +29,12 @@ silently destroy TPU serving performance without ever failing a test:
   time into ``memory.*`` gauges: dense KV strip bytes, draft-cache
   bytes, paged pool occupancy (``memory.pages_{used,free,cached}`` +
   ``memory.pool_pages``/``pool_bytes``) and the pager's prefix-cache
-  effectiveness counters (``paged.prefix_{hits,misses}``). When the
+  effectiveness counters (``paged.prefix_{hits,misses}``). Sharded
+  components report BOTH logical and per-device bytes
+  (``memory.kv_bytes_per_device`` / ``memory.pool_bytes_per_device``
+  via :func:`device_local_nbytes`) — under tensor parallelism the
+  logical size alone would read as if the whole cache lived on one
+  chip. When the
   backend provides ``device.memory_stats()`` (TPU/GPU; CPU does not),
   ``memory.hbm_bytes_in_use`` / ``memory.hbm_bytes_limit`` ride along.
   Sources are weakrefs: a retired batcher drops out of the gauges with
@@ -49,6 +54,7 @@ Catalog + semantics: ``docs/OBSERVABILITY.md`` "Engine telemetry".
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 import weakref
@@ -324,6 +330,24 @@ def global_compile_sentinel() -> CompileSentinel:
 
 
 # -- memory accounting ------------------------------------------------------
+
+
+def device_local_nbytes(x) -> int:
+    """PER-DEVICE bytes of one (possibly sharded) array: the shard
+    shape's bytes, i.e. global nbytes divided by the mesh factors on
+    every sharded axis. This is the number that matters for HBM
+    capacity planning under tensor parallelism — a tp-sharded KV cache's
+    ``nbytes`` is the LOGICAL size, which would read as if the whole
+    cache lived on one chip. Plain numpy / unsharded arrays just return
+    ``nbytes``."""
+    sharding = getattr(x, "sharding", None)
+    if sharding is None:
+        return int(x.nbytes)
+    try:
+        shard = sharding.shard_shape(x.shape)
+    except Exception:  # noqa: BLE001 — exotic shardings: logical bytes
+        return int(x.nbytes)
+    return int(math.prod(shard)) * x.dtype.itemsize
 
 #: Weakly-held memory sources: (label, id) -> object exposing
 #: ``_memory_stats() -> {metric_name: value}``. Weak values: a retired

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -75,8 +76,9 @@ CACHE_ENV = {
 #: r04 saw multi-hour outages, so a final attempt after a 5-minute
 #: backoff buys one more recovery window); CPU is the evidence-of-life
 #: fallback with a small iteration count — ResNet-50 bs=32 on CPU is
-#: ~seconds per batch. A HUNG probe switches to the tiny-first
-#: escalating schedule in main() instead.
+#: ~seconds per batch. A HUNG probe (a wedged runtime, killed with its
+#: whole process group) skips TPU entirely and degrades straight to the
+#: CPU row in main().
 ATTEMPTS = [
     ("tpu", 100, 5, 600, 0),
     ("tpu", 100, 3, 420, 30),
@@ -162,37 +164,52 @@ def main() -> int:
     # TPU attempt would burn its full child timeout — three of them plus
     # backoffs is ~40 min, past some driver timeouts (r03's BENCH was
     # rc=124 exactly this way). One cheap probe (own subprocess, own
-    # timeout) collapses the dead-relay schedule to a single short TPU
-    # shot + the CPU evidence-of-life row, keeping the healthy-relay
-    # schedule (and its numbers) untouched.
-    # Only a probe HANG collapses the schedule: a fast-failing relay
+    # hard timeout) detects the wedge up front, keeping the
+    # healthy-relay schedule (and its numbers) untouched.
+    # Only a probe HANG degrades the schedule: a fast-failing relay
     # (rc!=0 in seconds) costs the retry loop almost nothing and is
     # exactly the transient mode the backoff retries exist to ride out.
     # Probe output goes to a real file, not pipes — after a timeout,
-    # subprocess.run would block draining inherited pipe fds to EOF
-    # (the documented gotcha), turning the guard itself into a hang.
+    # draining inherited pipe fds to EOF would block (the documented
+    # subprocess gotcha), turning the guard itself into a hang.
+    # Popen + killpg, not subprocess.run: the probe child is a session
+    # leader (start_new_session), and a wedged PJRT runtime keeps
+    # helper processes/threads alive that survive a plain kill() of the
+    # direct child — r05's run still stalled AFTER the probe "timed
+    # out" because the group lingered holding the tunnel. SIGKILL the
+    # whole group, then reap with a BOUNDED wait so an unkillable child
+    # cannot turn the guard into the hang it guards against.
     import tempfile
 
-    probe_hung = False  # any non-TimeoutExpired failure = not hung (ADVICE r4)
+    probe_hung = False  # any non-timeout failure = not hung (ADVICE r4)
     with tempfile.TemporaryFile() as probe_err:
+        probe = None
         try:
-            probe = subprocess.run(
+            probe = subprocess.Popen(
                 [sys.executable, "-c", "import jax; jax.devices()"],
                 stdout=subprocess.DEVNULL,
                 stderr=probe_err,
-                timeout=120,
                 start_new_session=True,
             )
-            if probe.returncode != 0:
+            rc = probe.wait(timeout=120)
+            if rc != 0:
                 probe_err.seek(0)
                 tail = probe_err.read()[-200:].decode(errors="replace")
-                notes.append(
-                    f"relay probe rc={probe.returncode}: {tail.strip()}"
-                )
+                notes.append(f"relay probe rc={rc}: {tail.strip()}")
         except subprocess.TimeoutExpired:
             probe_hung = True
+            try:
+                os.killpg(probe.pid, signal.SIGKILL)
+            except OSError:  # group already gone / not permitted
+                probe.kill()
+            try:
+                probe.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                notes.append("relay probe unkillable (survived SIGKILL)")
         except Exception as exc:  # OSError etc: record, keep full schedule
             notes.append(f"relay probe error: {exc!r}")
+            if probe is not None and probe.poll() is None:
+                probe.kill()
 
     cache_warm = os.path.isdir(CACHE_DIR) and bool(os.listdir(CACHE_DIR))
 
@@ -273,16 +290,17 @@ def main() -> int:
         return 0
 
     if probe_hung:
-        # Degraded relay (r04 postmortem: even the degraded shot burned its
-        # 300 s on first compile). Tiny first — 10 scan iters, 2 trials,
-        # compile cached from the queue seed — then ESCALATE to the full
-        # config only once the relay has proven it can execute at all. A
-        # successful tiny shot is kept as the floor if escalation dies.
-        notes.append("relay probe HUNG (120s); tiny-first TPU schedule")
-        tiny = _attempt("tpu", 10, 2, 300)
-        if tiny is not None:
-            full = _attempt("tpu", 100, 5, 420)
-            return _emit(full if full is not None else tiny)
+        # WEDGED runtime, not a merely-slow one: the probe could not even
+        # enumerate devices in 120 s, so every TPU attempt would burn its
+        # full child timeout the same way (r05 postmortem: the
+        # tiny-first TPU escalation this branch used to run spent
+        # another 300 s timing out before the CPU row landed). Degrade
+        # STRAIGHT to the CPU evidence-of-life number — flagged
+        # "platform": "cpu" with the hang in "note", loud not silent.
+        notes.append("relay probe HUNG (120s); degrading to CPU")
+        record = _attempt("cpu", 3, 2, 600)
+        if record is not None:
+            return _emit(record)
     else:
         for platform, iters, trials, timeout_s, backoff_s in attempts:
             if backoff_s:
@@ -290,11 +308,6 @@ def main() -> int:
             record = _attempt(platform, iters, trials, timeout_s)
             if record is not None:
                 return _emit(record)
-    # Degraded path fallthrough: evidence-of-life CPU row.
-    if probe_hung:
-        record = _attempt("cpu", 3, 2, 600)
-        if record is not None:
-            return _emit(record)
 
     # Every attempt failed: still honor the one-JSON-line, rc=0 contract so
     # the driver records a diagnostic instead of a crash.

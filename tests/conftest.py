@@ -67,6 +67,32 @@ def devices():
     return devs
 
 
+@pytest.fixture(scope="session")
+def sim_mesh(devices):
+    """Factory for meshes over the virtual device pool — THE test-side
+    mesh constructor (the ``--xla_force_host_platform_device_count``
+    handling above feeds it). ``sim_mesh(4)`` builds a 1-axis
+    ``('tp', 4)`` mesh, ``sim_mesh(4, axis='pp')`` renames the axis, and
+    ``sim_mesh((('dp', 2), ('pp', 4)))`` builds a multi-axis mesh.
+    Skips the test cleanly when the pool holds fewer devices than the
+    mesh needs (e.g. a constrained environment where the XLA flag was
+    pinned lower), instead of failing on an opaque reshape."""
+
+    def build(spec, axis: str = "tp"):
+        from adapt_tpu.core.mesh import MeshSpec, build_mesh
+
+        axes = ((axis, spec),) if isinstance(spec, int) else tuple(spec)
+        mspec = MeshSpec(axes)
+        if mspec.num_devices > len(devices):
+            pytest.skip(
+                f"mesh {axes} needs {mspec.num_devices} devices, "
+                f"have {len(devices)}"
+            )
+        return build_mesh(mspec, devices)
+
+    return build
+
+
 @pytest.fixture
 def rng():
     return jax.random.PRNGKey(0)

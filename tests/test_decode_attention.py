@@ -173,3 +173,30 @@ def test_generate_bad_decode_attn_raises():
     prompt = jnp.zeros((1, 8), jnp.int32)
     with pytest.raises(ValueError, match="decode_attn"):
         generate(lm, variables, prompt, steps=2, decode_attn="cuda")
+
+
+def test_head_parity_guard_names_the_tp_mistake(rng):
+    """Mixing a head-sharded cache with globally-shaped queries (the
+    partial-TP-migration bug) must fail by name at the dispatch layer,
+    not as a broadcast error deep inside an einsum — for the contiguous,
+    verify and paged entry points alike."""
+    from adapt_tpu.ops.decode_attention import verify_attention
+    from adapt_tpu.ops.paged_attention import (
+        paged_attention,
+        paged_verify_attention,
+    )
+
+    q = jnp.zeros((2, 4, 2, 8))  # 4 KV-head rows
+    cache = jnp.zeros((2, 2, 16, 8))  # ...but a 2-head (per-shard) cache
+    with pytest.raises(ValueError, match="head count"):
+        decode_attention(q, cache, cache, 3)
+    with pytest.raises(ValueError, match="head count"):
+        verify_attention(q, cache, cache, jnp.zeros((2,), jnp.int32), 2)
+    pool = jnp.zeros((4, 2, 8, 8))
+    table = jnp.zeros((2, 2), jnp.int32)
+    with pytest.raises(ValueError, match="head count"):
+        paged_attention(q, pool, pool, table, 3)
+    with pytest.raises(ValueError, match="head count"):
+        paged_verify_attention(
+            q, pool, pool, table, jnp.zeros((2,), jnp.int32), 2
+        )

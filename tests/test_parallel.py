@@ -1,6 +1,7 @@
 """SPMD parallelism tests on the 8-device virtual mesh: pipeline schedule
 correctness (forward + gradients), ring attention vs full attention, and
-TP/DP sharded execution equivalence."""
+TP/DP sharded execution equivalence (ViT encoder + transformer-LM
+placement rules). Meshes come from conftest's ``sim_mesh`` factory."""
 
 import flax.linen as nn
 import jax
@@ -9,7 +10,6 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from adapt_tpu.core.mesh import MeshSpec, build_mesh
 from adapt_tpu.models.vit import EncoderBlock, vit_tiny
 from adapt_tpu.parallel.pipeline_spmd import (
     pipeline_microbatch,
@@ -21,18 +21,18 @@ from adapt_tpu.parallel.ring_attention import full_attention, ring_attention
 
 
 @pytest.fixture(scope="module")
-def pp_mesh(devices):
-    return build_mesh(MeshSpec((("pp", 4),)), devices)
+def pp_mesh(sim_mesh):
+    return sim_mesh(4, axis="pp")
 
 
 @pytest.fixture(scope="module")
-def dp_pp_mesh(devices):
-    return build_mesh(MeshSpec((("dp", 2), ("pp", 4))), devices)
+def dp_pp_mesh(sim_mesh):
+    return sim_mesh((("dp", 2), ("pp", 4)))
 
 
 @pytest.fixture(scope="module")
-def sp_mesh(devices):
-    return build_mesh(MeshSpec((("sp", 8),)), devices)
+def sp_mesh(sim_mesh):
+    return sim_mesh(8, axis="sp")
 
 
 @pytest.fixture(scope="module")
@@ -143,12 +143,12 @@ def test_ring_attention_bad_seq(sp_mesh):
         ring_attention(q, q, q, sp_mesh)
 
 
-def test_tp_dp_sharded_vit_matches_replicated(devices):
+def test_tp_dp_sharded_vit_matches_replicated(sim_mesh):
     """jit the full ViT-tiny with batch over dp and megatron TP rules over
     tp; GSPMD-inserted collectives must not change the math."""
     from adapt_tpu.parallel.sharding import shard_batch, tree_shardings
 
-    mesh = build_mesh(MeshSpec((("dp", 2), ("tp", 4))), devices)
+    mesh = sim_mesh((("dp", 2), ("tp", 4)))
     g = vit_tiny()
     x = jnp.ones((4, 32, 32, 3), jnp.float32)
     variables = g.init(jax.random.PRNGKey(0), x)
@@ -253,20 +253,19 @@ def test_ulysses_default_dispatch_uses_shared_predicate(sp_mesh, monkeypatch):
     )
 
 
-def test_vit_tp_rules_cover_attention_params(rng, devices):
+def test_vit_tp_rules_cover_attention_params(rng, sim_mesh):
     """Every encoder-block matmul weight must get a real TP split —
     regression for the attention-module rename silently falling through to
     replicated (P()) because the rules still matched flax's old
     query/key/value param names."""
-    import numpy as np
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from adapt_tpu.models.vit import vit_tiny
     from adapt_tpu.parallel.sharding import tree_shardings
 
     g = vit_tiny()
     variables = g.init(rng, jnp.ones((1, 32, 32, 3)))
-    mesh = Mesh(np.array(devices[:2]).reshape(1, 2), ("dp", "tp"))
+    mesh = sim_mesh((("dp", 1), ("tp", 2)))
     shardings = tree_shardings(variables, mesh)
 
     flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
@@ -407,7 +406,7 @@ def test_ring_attention_bad_layout(sp_mesh):
     "ranks,hop_buffers", [(2, 2), (3, 2), (4, 2), (4, 3)]
 )
 def test_spmd_overlap_matches_serial_bitexact(
-    devices, stacked_blocks, ranks, hop_buffers
+    sim_mesh, stacked_blocks, ranks, hop_buffers
 ):
     """The overlap schedule must be a pure PERF knob: for 2-4 stages
     (and a deeper hop buffer) its outputs are BIT-IDENTICAL to the
@@ -416,7 +415,7 @@ def test_spmd_overlap_matches_serial_bitexact(
     block, per_block, stacked = stacked_blocks
     if len(per_block) % ranks:
         stacked = jax.tree.map(lambda x: x[: 2 * ranks], stacked)
-    mesh = Mesh(np.array(devices[:ranks]), ("pp",))
+    mesh = sim_mesh(ranks, axis="pp")
     batch = jax.random.normal(jax.random.PRNGKey(7), (8, 10, 32))
     xs = pipeline_microbatch(batch, num_micro=8)
 
@@ -504,3 +503,180 @@ def test_spmd_schedule_knobs_validated(pp_mesh, stacked_blocks):
         PipelineConfig(schedule="eager")
     with pytest.raises(ValueError, match="hop_buffers"):
         PipelineConfig(hop_buffers=0)
+
+
+# -- transformer-LM TP placement rules --------------------------------------
+
+
+def _flat_specs(tree):
+    """{path: PartitionSpec} for a tree_shardings result."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return {
+        "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        ): s.spec
+        for path, s in flat
+    }
+
+
+def _lm_gqa_moe(rng):
+    from adapt_tpu.models.transformer_lm import transformer_lm
+
+    lm = transformer_lm(37, 32, 2, 4, 64, max_len=48, kv_heads=2,
+                        moe_experts=4)
+    variables = lm.graph.init(rng, jnp.zeros((1, 4), jnp.int32))
+    return lm, variables
+
+
+def test_lm_tp_rules_cover_gqa_moe_params(rng, sim_mesh):
+    """Every param path in a GQA+MoE TransformerLM matches AT MOST one
+    placement rule, the matmul weights that must shard match EXACTLY
+    one, and the column/row splits land on the intended axes (heads /
+    kv-heads / hidden columns; contracted dims rows). Norms, embeds,
+    the MoE router gate and the post-psum biases replicate."""
+    import re
+
+    from adapt_tpu.parallel.sharding import (
+        _LM_TP_PATTERNS,
+        lm_tp_rules,
+        tree_shardings,
+    )
+
+    _, variables = _lm_gqa_moe(rng)
+    mesh = sim_mesh(2)
+    specs = _flat_specs(
+        tree_shardings(variables, mesh, rules=lm_tp_rules)
+    )
+    flat = jax.tree_util.tree_flatten_with_path(variables)[0]
+    ndims = {
+        "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        ): leaf.ndim
+        for path, leaf in flat
+    }
+    for path, nd in ndims.items():
+        matches = [
+            pat for pat, spec in _LM_TP_PATTERNS
+            if re.fullmatch(pat, path) and len(spec) == nd
+        ]
+        assert len(matches) <= 1, (path, matches)
+
+    # GQA attention: q/kv column-split on their HEAD axes, out row-split.
+    assert specs["decoder_block_0/params/attn/q/kernel"] == P(
+        None, "tp", None
+    )
+    assert specs["decoder_block_0/params/attn/q/bias"] == P("tp", None)
+    assert specs["decoder_block_0/params/attn/kv/kernel"] == P(
+        None, None, "tp", None
+    )
+    assert specs["decoder_block_0/params/attn/kv/bias"] == P(
+        None, "tp", None
+    )
+    assert specs["decoder_block_0/params/attn/out/kernel"] == P("tp", None)
+    # MoE experts: HIDDEN axis splits, expert axis left for 'ep'.
+    assert specs["decoder_block_0/params/moe/w1"] == P(None, None, "tp")
+    assert specs["decoder_block_0/params/moe/b1"] == P(None, "tp")
+    assert specs["decoder_block_0/params/moe/w2"] == P(None, "tp", None)
+    # Head: row split on the contracted model dim (logits replicate
+    # after one psum — sampling is sharding-blind).
+    assert specs["head/params/logits/kernel"] == P("tp", None)
+    # Everything position/norm/router-side replicates.
+    for path in (
+        "decoder_block_0/params/ln1/scale",
+        "decoder_block_0/params/ln2/bias",
+        "decoder_block_0/params/attn/out/bias",
+        "decoder_block_0/params/moe/gate",
+        "decoder_block_0/params/moe/b2",
+        "embed/params/tok/embedding",
+        "embed/params/pos_embed",
+        "head/params/logits/bias",
+    ):
+        assert specs[path] == P(), path
+    # Dense-MLP variant of the same rules (no MoE): in column / out row.
+    from adapt_tpu.models.transformer_lm import transformer_lm
+
+    dense = transformer_lm(37, 32, 1, 4, 64, max_len=48)
+    dvars = dense.graph.init(rng, jnp.zeros((1, 4), jnp.int32))
+    dspecs = _flat_specs(
+        tree_shardings(dvars, mesh, rules=lm_tp_rules)
+    )
+    assert dspecs["decoder_block_0/params/mlp_in/kernel"] == P(None, "tp")
+    assert dspecs["decoder_block_0/params/mlp_in/bias"] == P("tp")
+    assert dspecs["decoder_block_0/params/mlp_out/kernel"] == P("tp", None)
+    assert dspecs["decoder_block_0/params/mlp_out/bias"] == P()
+    # Fused-QKV MHA variant: the heads axis of the (d, 3, h, hd) kernel.
+    assert dspecs["decoder_block_0/params/attn/qkv/kernel"] == P(
+        None, None, "tp", None
+    )
+    assert dspecs["decoder_block_0/params/attn/qkv/bias"] == P(
+        None, "tp", None
+    )
+
+
+def test_lm_tp_expert_params_compose_with_ep(rng, sim_mesh):
+    """The MoE expert weights' TP spec (hidden axis) composes with
+    parallel/expert.py's EP spec (leading expert axis) via merge_specs,
+    and the merged placement actually lands: on an (ep=2, tp=2) mesh
+    each device holds E/2 experts x hidden/2 columns."""
+    from adapt_tpu.parallel.expert import expert_shardings
+    from adapt_tpu.parallel.sharding import lm_tp_rules, merge_specs
+
+    _, variables = _lm_gqa_moe(rng)
+    mesh = sim_mesh((("ep", 2), ("tp", 2)))
+    moe = variables["decoder_block_0"]["params"]["moe"]
+    ep_specs = _flat_specs(
+        expert_shardings(moe, mesh, num_experts=4)
+    )
+    merged_w1 = merge_specs(
+        ep_specs["w1"],
+        lm_tp_rules("decoder_block_0/params/moe/w1", moe["w1"].ndim),
+    )
+    assert merged_w1 == P("ep", None, "tp")
+    merged_w2 = merge_specs(
+        ep_specs["w2"],
+        lm_tp_rules("decoder_block_0/params/moe/w2", moe["w2"].ndim),
+    )
+    assert merged_w2 == P("ep", "tp", None)
+    placed = jax.device_put(
+        moe["w1"], NamedSharding(mesh, merged_w1)
+    )  # (4, 32, 64) experts x d x hidden
+    assert placed.sharding.shard_shape(placed.shape) == (2, 32, 32)
+    # The router gate stays replicated under BOTH placements.
+    assert ep_specs["gate"] == P()
+    assert lm_tp_rules("decoder_block_0/params/moe/gate", 2) == P()
+    with pytest.raises(ValueError, match="conflict"):
+        merge_specs(P("ep", None), P("tp", None))
+
+
+def test_lm_tp_sharded_serving_matches_replicated(rng, sim_mesh):
+    """End to end: a GQA LM placed by lm_tp_rules on a tp=4 mesh emits
+    the same greedy tokens as the unsharded model (GSPMD collectives
+    change reduction order, never the decoded stream), and the full-
+    sequence logits agree to fp tolerance."""
+    from jax.sharding import NamedSharding as NS
+
+    from adapt_tpu.models.transformer_lm import (
+        generate,
+        logits_full,
+        transformer_lm,
+    )
+    from adapt_tpu.parallel.sharding import lm_tp_rules, tree_shardings
+
+    lm = transformer_lm(37, 32, 2, 8, 64, max_len=48, kv_heads=4)
+    variables = lm.graph.init(rng, jnp.zeros((1, 4), jnp.int32))
+    mesh = sim_mesh(4)
+    sharded = jax.device_put(
+        variables, tree_shardings(variables, mesh, rules=lm_tp_rules)
+    )
+    ids = jnp.asarray([[1, 5, 9, 2]], jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(logits_full(lm, sharded, jax.device_put(
+            ids, NS(mesh, P())
+        ))),
+        np.asarray(logits_full(lm, variables, ids)),
+        rtol=2e-5, atol=2e-5,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(generate(lm, sharded, ids, 8)),
+        np.asarray(generate(lm, variables, ids, 8)),
+    )
