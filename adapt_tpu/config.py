@@ -157,10 +157,25 @@ class SpeculativeConfig:
     #: draft misses; past ~4-8 the marginal proposal is usually rejected
     #: (acceptance compounds per position).
     draft_k: int = 4
+    #: Resident dtype of the DRAFT model's weights: ``"native"`` keeps
+    #: them as given; ``"int8"`` stores every matrix leaf blockwise
+    #: int8-quantized (``ops.quantize.quantize_params``) with dequant
+    #: fused inside the draft programs. The draft REPLICATES under
+    #: tensor parallelism, so this directly cuts the per-chip HBM cost
+    #: of speculation ~4x (f32 weights); the draft's quality only
+    #: affects acceptance rate, never the emitted stream (losslessness
+    #: is the target's property), so a slightly-perturbed draft is the
+    #: cheapest capacity knob speculation has.
+    draft_weight_dtype: str = "native"
 
     def __post_init__(self):
         if self.draft_k < 1:
             raise ValueError(f"draft_k must be >= 1, got {self.draft_k}")
+        if self.draft_weight_dtype not in ("native", "int8"):
+            raise ValueError(
+                f"draft_weight_dtype={self.draft_weight_dtype!r}: "
+                "expected 'native' or 'int8'"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -38,9 +38,13 @@ carry token and position stay device-resident). The old loop fetched
 the proposals, re-uploaded them into the verify chunk, then fetched the
 predictions — three transfers and two syncs per round.
 
-v1 scope: greedy (temperature 0 — where losslessness is exact
-equality), native-dtype caches. Single-request here; the batched
-composition lives in the continuous batcher's speculative mode.
+Scope of THIS module's loop: greedy (temperature 0 — where
+losslessness is exact equality), native-dtype caches, single-request.
+The batched composition lives in the continuous batcher's speculative
+mode — which also serves int8 KV caches (``verify_chunk`` /
+``verify_chunk_paged`` quantize their appends) and int8 draft WEIGHTS
+(``SpeculativeConfig.draft_weight_dtype``; :func:`draft_chunk`
+dequantizes them in-program).
 
 Numerics fine print: "exact equality" assumes the chunked verify and the
 sequential decode produce bitwise-equal logits. They run the same ops in
@@ -60,6 +64,7 @@ import numpy as np
 from jax import lax
 
 from adapt_tpu.models.transformer_lm import TransformerLM
+from adapt_tpu.ops.quantize import dequantize_params
 
 
 def _modules(lm: TransformerLM):
@@ -97,7 +102,14 @@ def draft_chunk(lm: TransformerLM, variables, tok, index, caches, *, n):
     (b,) (batched speculation: each slot drafts from its OWN position —
     negative rows are dead slots whose writes clamp into their own
     row's masked space). One compiled program either way; the
-    continuous batcher's speculative tick calls this exact jit."""
+    continuous batcher's speculative tick calls this exact jit.
+
+    ``variables`` may carry int8-quantized matrix leaves
+    (``SpeculativeConfig.draft_weight_dtype="int8"``,
+    ``ops.quantize.quantize_params``): they dequantize HERE, inside the
+    compiled program, so the persistent HBM residency stays int8 and
+    the f32 weights exist only for the scan's lifetime."""
+    variables = dequantize_params(variables)
     embed, blocks, head = _modules(lm)
     per_row = bool(jnp.ndim(index))
 

@@ -43,6 +43,7 @@ from adapt_tpu.ops.paged_attention import (
     paged_attention,
     paged_chunk_attention,
     paged_verify_attention,
+    pool_values,
 )
 from adapt_tpu.models.moe import MoEDecoderMlp
 from adapt_tpu.ops.quantize import quantize_kv_vectors
@@ -242,6 +243,24 @@ class CausalSelfAttention(nn.Module):
     # same function, so the definition cannot fork).
     _quantize_kv = staticmethod(quantize_kv_vectors)
 
+    def _write_kv_pair(self, cache_k, cache_v, k, v, write):
+        """Fan one K/V cache write out over the cache's representation:
+        quantized ``(values, scales)`` pairs quantize ``k``/``v`` with
+        the shared absmax scheme and apply ``write`` to BOTH members;
+        native caches write directly. ``write(member, new)`` is each
+        call site's own primitive (page scatter, chunk scatter,
+        ``append_kv``) — this is THE one quantize-then-write-both
+        definition, so the decode/prefill/verify paths cannot
+        diverge."""
+        if isinstance(cache_k, tuple):
+            kq, ks = self._quantize_kv(k)
+            vq, vs = self._quantize_kv(v)
+            return (
+                (write(cache_k[0], kq), write(cache_k[1], ks)),
+                (write(cache_v[0], vq), write(cache_v[1], vs)),
+            )
+        return write(cache_k, k), write(cache_v, v)
+
     def prefill(self, x, max_len: int, valid_from=None, quantize_cache=False):
         """Full causal attention over the prompt, returning output plus
         K/V caches padded to ``max_len`` (zeros beyond the prompt are
@@ -320,18 +339,15 @@ class CausalSelfAttention(nn.Module):
         # GQA: fold query-head groups into query rows so the attention
         # runs unchanged against the small (b, kv_h, L, hd) cache.
         q = self._group_q(q)  # (b, kv_h, g, hd)
-        if quantized:
-            (kvl, ksc), (vvl, vsc) = cache_k, cache_v
-            nk, nks = self._quantize_kv(k)
-            nv, nvs = self._quantize_kv(v)
-            kvl = self._cache_write(kvl, nk, index)
-            ksc = self._cache_write(ksc, nks, index)
-            vvl = self._cache_write(vvl, nv, index)
-            vsc = self._cache_write(vsc, nvs, index)
-            cache_k, cache_v = (kvl, ksc), (vvl, vsc)
-        else:
-            cache_k = self._cache_write(cache_k, k, index)
-            cache_v = self._cache_write(cache_v, v, index)
+        # The cache representation is authoritative (tuple iff
+        # quantized — prefill builds it that way); the ``quantized``
+        # parameter is the callers' static-arg plumbing, kept for
+        # signature stability.
+        del quantized
+        cache_k, cache_v = self._write_kv_pair(
+            cache_k, cache_v, k, v,
+            lambda c, t: self._cache_write(c, t, index),
+        )
         o = decode_attention(
             q, cache_k, cache_v, index,
             self._window_from(index, b, valid_from), prefer=attn_impl,
@@ -349,13 +365,15 @@ class CausalSelfAttention(nn.Module):
         write this step's K/V into the slot's physical page at
         ``index``'s (page, offset), then attend over the table-mapped
         window. ``index`` scalar or (b,) as in ``decode_step``; pools
-        are (num_pages, kv_h, P, hd); ``page_table`` (b, pages_per_slot)
+        are (num_pages, kv_h, P, hd) arrays or quantized ``(int8
+        values, f32 scales)`` PAIRS of pools (scales (num_pages, kv_h,
+        P, 1); this step's K/V quantize via the shared absmax scheme
+        before the scatter, and dequant fuses into the attention — see
+        ``ops/paged_attention``); ``page_table`` (b, pages_per_slot)
         int32 (idle rows may map everything to the trash page — their
-        writes land there, unread). Native-dtype pools only (int8 +
-        paging both buy capacity; compose them when a workload needs
-        both — see ``ops/paged_attention``)."""
+        writes land there, unread)."""
         b = x_t.shape[0]
-        page = k_pool.shape[2]
+        page = pool_values(k_pool).shape[2]
         q, k, v = self._project(x_t)  # q (b, h, 1, hd); k/v (b, kv_h, 1, hd)
         idx = jnp.broadcast_to(
             jnp.asarray(index, jnp.int32).reshape(-1), (b,)
@@ -376,13 +394,14 @@ class CausalSelfAttention(nn.Module):
         )[:, 0]  # (b,) physical page of each row's write
         phys = jnp.where(live_row, phys, 0)
         off = safe % page
+
         # Advanced-index scatter: rows (phys[i], :, off[i], :) <- token i.
-        k_pool = k_pool.at[phys, :, off, :].set(
-            k[:, :, 0, :].astype(k_pool.dtype)
-        )
-        v_pool = v_pool.at[phys, :, off, :].set(
-            v[:, :, 0, :].astype(v_pool.dtype)
-        )
+        def write(pool, t):
+            return pool.at[phys, :, off, :].set(
+                t[:, :, 0, :].astype(pool.dtype)
+            )
+
+        k_pool, v_pool = self._write_kv_pair(k_pool, v_pool, k, v, write)
         o = paged_attention(
             q, k_pool, v_pool, page_table, index,
             self._window_from(index, b, valid_from), prefer=attn_impl,
@@ -401,9 +420,15 @@ class CausalSelfAttention(nn.Module):
         scatter-back (the chunked-prefill counterpart of
         ``decode_step_paged``). ``pages`` (n,) covers [0, pos0 + C)
         (pow2 trash padding allowed); ``pos0`` is page-aligned and C is
-        a whole number of pages. Batch 1 (prefill is per request)."""
+        a whole number of pages. Batch 1 (prefill is per request).
+        Quantized ``(values, scales)`` pool pairs quantize the chunk's
+        K/V before the page scatter — note the chunk then ATTENDS the
+        already-quantized earlier window, so a chunked/suffix prefill
+        over int8 pools carries the cache's quantization error into the
+        chunk's hidden states (same fine print as chunk fp contraction
+        widths, one quantization step coarser)."""
         b, c, d = x.shape
-        page = k_pool.shape[2]
+        page = pool_values(k_pool).shape[2]
         q, k, v = self._project(x)  # q (1, h, C, hd); k/v (1, kv_h, C, hd)
         q, k = self._rope_qk(q, k, pos0 + jnp.arange(c))
         q = self._group_q(q)  # (1, kv_h, g*C, hd)
@@ -412,11 +437,16 @@ class CausalSelfAttention(nn.Module):
             jnp.asarray(pages, jnp.int32), (pos0 // page,), (n_chunk,)
         )
         kvh, hd = k.shape[1], k.shape[3]
-        to_pages = lambda t: jnp.swapaxes(
-            t[0].reshape(kvh, n_chunk, page, hd), 0, 1
-        )
-        k_pool = k_pool.at[chunk_pages].set(to_pages(k).astype(k_pool.dtype))
-        v_pool = v_pool.at[chunk_pages].set(to_pages(v).astype(v_pool.dtype))
+
+        def to_pages(t):  # (1, kv_h, C, w) -> (n_chunk, kv_h, page, w)
+            return jnp.swapaxes(
+                t[0].reshape(kvh, n_chunk, page, t.shape[3]), 0, 1
+            )
+
+        def write(pool, t):
+            return pool.at[chunk_pages].set(to_pages(t).astype(pool.dtype))
+
+        k_pool, v_pool = self._write_kv_pair(k_pool, v_pool, k, v, write)
         o = paged_chunk_attention(
             q, k_pool, v_pool, pages, pos0, c, prefer=attn_impl,
             window=self.window,
@@ -439,7 +469,11 @@ class CausalSelfAttention(nn.Module):
         The chunk K/V write is one ``append_kv`` scatter; rejected
         suffixes need no rollback — the position mask simply never
         admits them (the same trash-slot discipline the continuous
-        batcher uses)."""
+        batcher uses). Quantized ``(int8 values, f32 scales)`` cache
+        pairs quantize the chunk's K/V with the shared absmax scheme
+        before the append — the same values K sequential quantized
+        ``decode_step`` calls would write, so quantized verify logits
+        equal the sequential quantized decode's."""
         b, kc, d = x.shape
         q, k, v = self._project(x)  # q (b, h, K, hd); k/v (b, kv_h, K, hd)
         if jnp.ndim(index):
@@ -448,8 +482,9 @@ class CausalSelfAttention(nn.Module):
             pos = index + jnp.arange(kc)
         q, k = self._rope_qk(q, k, pos)
         q = self._group_q(q)  # (b, kv_h, g*K, hd), row = member*K + pos
-        cache_k = append_kv(cache_k, k, index)
-        cache_v = append_kv(cache_v, v, index)
+        cache_k, cache_v = self._write_kv_pair(
+            cache_k, cache_v, k, v, lambda c, t: append_kv(c, t, index)
+        )
         o = verify_attention(
             q, cache_k, cache_v, index, kc, window=self.window
         ).astype(x.dtype)
@@ -467,9 +502,12 @@ class CausalSelfAttention(nn.Module):
         (:func:`paged_verify_attention`) — ``verify_chunk``'s exact
         semantics over ``decode_step_paged``'s layout. ``index`` (b,);
         a negative row is dead (idle or mid-chunked-prefill slot): its
-        writes route to the trash page and its positions all mask."""
+        writes route to the trash page and its positions all mask.
+        Quantized ``(values, scales)`` pool pairs scatter the chunk's
+        quantized K/V into both members (the scale plane rides the
+        same page table)."""
         b, kc, _ = x.shape
-        page = k_pool.shape[2]
+        page = pool_values(k_pool).shape[2]
         q, k, v = self._project(x)  # q (b, h, K, hd); k/v (b, kv_h, K, hd)
         idx = jnp.broadcast_to(
             jnp.asarray(index, jnp.int32).reshape(-1), (b,)
@@ -487,12 +525,12 @@ class CausalSelfAttention(nn.Module):
         # Advanced-index scatter: (phys[b,t], :, off[b,t], :) <- token t
         # of slot b. Dead rows' K writes pile unordered onto the trash
         # page — never read (their masks are empty).
-        k_pool = k_pool.at[phys, :, off, :].set(
-            jnp.swapaxes(k, 1, 2).astype(k_pool.dtype)
-        )
-        v_pool = v_pool.at[phys, :, off, :].set(
-            jnp.swapaxes(v, 1, 2).astype(v_pool.dtype)
-        )
+        def write(pool, t):
+            return pool.at[phys, :, off, :].set(
+                jnp.swapaxes(t, 1, 2).astype(pool.dtype)
+            )
+
+        k_pool, v_pool = self._write_kv_pair(k_pool, v_pool, k, v, write)
         o = paged_verify_attention(
             q, k_pool, v_pool, page_table, idx, kc, prefer=attn_impl,
             window=self.window,

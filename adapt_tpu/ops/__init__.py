@@ -1,20 +1,27 @@
 from adapt_tpu.ops.quantize import (
     QuantizedTensor,
     dequantize,
+    dequantize_params,
     dequantize_reference,
     quantize,
+    quantize_kv_vectors,
+    quantize_params,
     quantize_reference,
 )
 from adapt_tpu.ops.attention import attention_reference, flash_attention
 from adapt_tpu.ops.decode_attention import (
     decode_attention,
     decode_attention_reference,
+    verify_attention,
 )
 from adapt_tpu.ops.paged_attention import (
     paged_attention,
     paged_attention_reference,
     paged_chunk_attention,
     paged_chunk_attention_reference,
+    paged_verify_attention,
+    paged_verify_attention_reference,
+    pool_values,
 )
 
 __all__ = [
@@ -23,12 +30,19 @@ __all__ = [
     "decode_attention",
     "decode_attention_reference",
     "dequantize",
+    "dequantize_params",
     "dequantize_reference",
     "flash_attention",
     "paged_attention",
     "paged_attention_reference",
     "paged_chunk_attention",
     "paged_chunk_attention_reference",
+    "paged_verify_attention",
+    "paged_verify_attention_reference",
+    "pool_values",
     "quantize",
+    "quantize_kv_vectors",
+    "quantize_params",
     "quantize_reference",
+    "verify_attention",
 ]

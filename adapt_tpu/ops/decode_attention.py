@@ -337,22 +337,44 @@ def verify_attention(q, cache_k, cache_v, index, chunk: int, window=None):
     masks out and the output is finite garbage nothing reads — the same
     discipline as the batcher's trash slot.
 
+    Caches may be ``(int8 values, f32 scales)`` pairs — the SAME
+    quantized layout (and the same score/probability-column scale
+    application, in the same op order) as
+    ``decode_attention_reference``, so a quantized verify chunk's K
+    logits equal what K sequential quantized ``decode_step`` calls
+    produce: the speculative-verify path over an int8 cache.
+
     The einsum schedule is ``decode_attention_reference``'s with a
     per-row diagonal instead of a shared newest position; XLA-only for
     now (``decode_kernel_wins`` rules the streaming kernel out
     everywhere until its hardware A/B lands, and verify amortizes the
     cache stream over K rows already)."""
-    check_head_parity(q.shape[1], cache_k.shape[1])
+    quantized = isinstance(cache_k, tuple)
     sm = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
-    s = (
-        jnp.einsum(
+    if quantized:
+        (kvl, ksc), (vvl, vsc) = cache_k, cache_v
+        check_head_parity(q.shape[1], kvl.shape[1])
+        # Scales factor OUT of the per-vector dot: apply them to the
+        # score columns in decode_attention_reference's exact op order,
+        # so per-row values match the sequential quantized decode.
+        s = jnp.einsum(
             "bhqd,bhkd->bhqk",
             q.astype(jnp.float32),
-            cache_k.astype(jnp.float32),
-        )
-        * sm
-    )  # (b, kv_h, g*chunk, L)
-    cols = jnp.arange(cache_k.shape[2])
+            kvl.astype(jnp.float32),
+        ) * jnp.swapaxes(ksc, 2, 3) * sm
+        n_pos = kvl.shape[2]
+    else:
+        check_head_parity(q.shape[1], cache_k.shape[1])
+        s = (
+            jnp.einsum(
+                "bhqd,bhkd->bhqk",
+                q.astype(jnp.float32),
+                cache_k.astype(jnp.float32),
+            )
+            * sm
+        )  # (b, kv_h, g*chunk, L)
+        n_pos = cache_k.shape[2]
+    cols = jnp.arange(n_pos)
     rows = jnp.arange(q.shape[2]) % chunk  # row -> chunk position t
     if jnp.ndim(index):
         edge = index[:, None, None] + rows[None, :, None]  # (b, g*K, 1)
@@ -367,7 +389,16 @@ def verify_attention(q, cache_k, cache_v, index, chunk: int, window=None):
             live = live & (cols[None, :] > edge - window)
         s = jnp.where(live[None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, cache_v.astype(jnp.float32))
+    if quantized:
+        o = jnp.einsum(
+            "bhqk,bhkd->bhqd",
+            p * jnp.swapaxes(vsc, 2, 3),
+            vvl.astype(jnp.float32),
+        )
+    else:
+        o = jnp.einsum(
+            "bhqk,bhkd->bhqd", p, cache_v.astype(jnp.float32)
+        )
     return o.astype(q.dtype)
 
 

@@ -22,11 +22,26 @@ FETCH differs, which is the whole point: one attention discipline, two
 memory layouts.
 
 Layouts:
-- pool: (num_pages, kv_heads, page_size, head_dim), native dtype
-  (bf16/f32). int8 pools are future work — per-vector scale tiles need
-  the 1024-chunk trick of ``decode_attention``, which fights the small
-  page sizes paging wants; paging and int8 both buy capacity, compose
-  them when a workload needs both.
+- pool: (num_pages, kv_heads, page_size, head_dim) in the native dtype
+  (bf16/f32), OR an ``(int8 values, f32 scales)`` PAIR of pools —
+  values (num_pages, kv_heads, page_size, head_dim) int8, scales
+  (num_pages, kv_heads, page_size, 1) f32, one absmax scale per cached
+  K/V vector (``ops/quantize.quantize_kv_vectors``, the same scheme as
+  the dense int8 strips). Quantized pools compose paging's
+  resident-token capacity with int8's ~2-4x byte shrink: the scale
+  plane rides the SAME page table (page id addresses both pools), and
+  the kernels stream it as one chunked (page/128, 128) f32 tile per
+  page — 4/head_dim of the int8 payload's bytes (one f32 per vector)
+  — applying scales to the score/probability
+  COLUMNS so the big cache operand stays int8 end to end (dequant fused
+  in VMEM, the ``_decode_kernel`` discipline). On REAL TPUs the
+  quantized kernel path additionally requires
+  ``page % DECODE_BLOCK_K == 0`` so the scale tile fills a full f32
+  (8, 128) tile (``_kernel_supported`` — the dense int8 path's
+  constraint); smaller quantized pages serve through the XLA oracle
+  until a hardware A/B motivates a packed-scale layout. Off-TPU the
+  interpreter has no tiling, so CI parity drives the quantized kernel
+  bodies at ordinary page sizes.
 - page table: (slots, pages_per_slot) int32 physical page ids; entries
   past a slot's live window may be ANY valid page id (their positions
   are masked, their blocks' compute skipped — point them at page 0).
@@ -48,7 +63,11 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from adapt_tpu.ops.decode_attention import _decode_kernel, check_head_parity
+from adapt_tpu.ops.decode_attention import (
+    DECODE_BLOCK_K,
+    _decode_kernel,
+    check_head_parity,
+)
 
 try:
     from jax.experimental.pallas import tpu as pltpu
@@ -61,15 +80,55 @@ except Exception:  # pragma: no cover — jax builds without pallas-tpu
 DEFAULT_PAGE_SIZE = 128
 
 
+def pool_values(pool):
+    """The VALUE array of a pool operand: the int8 member of a
+    quantized ``(values, scales)`` pair, the pool itself otherwise —
+    the one place shape/head/page derivation looks, so every entry
+    point sees through the tuple identically."""
+    return pool[0] if isinstance(pool, tuple) else pool
+
+
+def _split_pools(k_pool, v_pool):
+    """Split possibly-quantized pool operands into ``(k_vals, v_vals,
+    k_scales, v_scales)`` — scales ``None`` for native pools. THE one
+    unpack the three kernel dispatchers share, so a future change to
+    the pair representation lands in one place."""
+    if isinstance(k_pool, tuple):
+        (kv, ks), (vv, vs) = k_pool, v_pool
+        return kv, vv, ks, vs
+    return k_pool, v_pool, None, None
+
+
+def _kernel_supported(page: int, quantized: bool) -> bool:
+    """Shared pallas-dispatch gate for the three paged kernels. Native
+    pools need a lane-multiple page. Quantized pools ALSO need the
+    scale tile to satisfy f32 (8, 128) tiling ON HARDWARE: a page
+    carries page/128 rows of 128 scales, so real TPUs require
+    ``page % DECODE_BLOCK_K == 0`` (the dense int8 path's documented
+    constraint — small pages would hand Mosaic a 1-sublane f32 tile);
+    smaller quantized pages fall back to the XLA oracle until a
+    hardware A/B motivates a packed-scale layout. The INTERPRETER has
+    no tiling, so off-TPU the CI parity tests still drive the quantized
+    kernel bodies at ordinary page sizes."""
+    if pltpu is None or page % 128:
+        return False
+    if quantized and jax.default_backend() == "tpu":
+        return page % DECODE_BLOCK_K == 0
+    return True
+
+
 def paged_attention_reference(q, k_pool, v_pool, page_table, index,
                               valid_from=None):
     """jnp oracle: gather each slot's pages into a contiguous window,
-    then run the contiguous decode-attention oracle. This is the
-    semantics definition AND the materializing schedule the kernel
-    exists to beat.
+    then run the contiguous decode-attention oracle (which owns the
+    quantized score/probability-column scale application — one
+    definition, so paged int8 decode matches the dense int8 slot path
+    value-for-value). This is the semantics definition AND the
+    materializing schedule the kernel exists to beat.
 
-    q (b, kvh, g, hd); pools (num_pages, kvh, P, hd); page_table
-    (b, pages_per_slot) int32; index scalar or (b,)."""
+    q (b, kvh, g, hd); pools (num_pages, kvh, P, hd) or ``(int8 values,
+    f32 scales)`` pairs; page_table (b, pages_per_slot) int32; index
+    scalar or (b,)."""
     from adapt_tpu.ops.decode_attention import decode_attention_reference
 
     b = q.shape[0]
@@ -79,16 +138,23 @@ def paged_attention_reference(q, k_pool, v_pool, page_table, index,
         g_ = jnp.moveaxis(g_, 2, 1)
         return g_.reshape(b, pool.shape[1], -1, pool.shape[3])
 
+    if isinstance(k_pool, tuple):
+        cache_k = (gather(k_pool[0]), gather(k_pool[1]))
+        cache_v = (gather(v_pool[0]), gather(v_pool[1]))
+    else:
+        cache_k, cache_v = gather(k_pool), gather(v_pool)
     return decode_attention_reference(
-        q, gather(k_pool), gather(v_pool), index, valid_from
+        q, cache_k, cache_v, index, valid_from
     )
 
 
 @functools.partial(jax.jit, static_argnames=())
-def _paged_impl(q, k_pool, v_pool, page_table, index, valid_from):
+def _paged_impl(q, k_pool, v_pool, k_scales, v_scales, page_table, index,
+                valid_from):
     b, kvh, g, hd = q.shape
     page = k_pool.shape[2]
     pages_per_slot = page_table.shape[1]
+    quantized = k_scales is not None
     has_vf = valid_from is not None
     pad_g = (-g) % 8
     if pad_g:
@@ -126,6 +192,22 @@ def _paged_impl(q, k_pool, v_pool, page_table, index, valid_from):
         pl.BlockSpec((1,), smem_map, memory_space=pltpu.SMEM),
     ]
     operands = [qf, k_pool, v_pool, idx]
+    if quantized:
+        # (pages, kvh, P, 1) f32 scale pools -> (pages, kvh, P/128,
+        # 128) CHUNKED views (position = row*128 + lane — the dense
+        # kernel's scale-tile trick, so a >=1024 page fills whole f32
+        # (8, 128) tiles on hardware); table-addressed by the SAME
+        # scalar-prefetch index_map as the int8 payload, 4/head_dim of
+        # its bytes (one f32 per int8 vector).
+        for s in (k_scales, v_scales):
+            operands.append(
+                s.reshape(s.shape[0], kvh, page // 128, 128)
+            )
+            in_specs.append(
+                pl.BlockSpec(
+                    (1, 1, page // 128, 128), kv_map, memory_space=_VMEM
+                )
+            )
     if has_vf:
         operands.append(jnp.repeat(jnp.asarray(valid_from, jnp.int32), kvh))
         in_specs.append(
@@ -137,6 +219,7 @@ def _paged_impl(q, k_pool, v_pool, page_table, index, valid_from):
         block_k=page,
         num_kv=pages_per_slot,
         sm_scale=sm_scale,
+        quantized=quantized,
         has_vf=has_vf,
     )
     on_tpu = jax.default_backend() == "tpu"
@@ -168,11 +251,14 @@ def _paged_impl(q, k_pool, v_pool, page_table, index, valid_from):
 
 
 def _paged_kernel(table_ref, q_ref, k_ref, v_ref, idx_ref, *refs, block_k,
-                  num_kv, sm_scale, has_vf):
+                  num_kv, sm_scale, quantized, has_vf):
     """Scalar-prefetch wrapper: the table ref arrives first (consumed by
     the index_maps, unused in the body) and the K/V tiles arrive as
     (1, 1, page, hd) — drop the head axis and delegate to the contiguous
-    decode kernel body (one attention discipline, two layouts)."""
+    decode kernel body (one attention discipline, two layouts).
+    Quantized pools add chunked (1, 1, page/128, 128) f32 scale tiles,
+    table-addressed like the int8 payload; ``_decode_kernel``'s quantized branch applies
+    them to the score/probability columns in VMEM — the fused dequant."""
     del table_ref
     _decode_kernel(
         q_ref,
@@ -183,21 +269,27 @@ def _paged_kernel(table_ref, q_ref, k_ref, v_ref, idx_ref, *refs, block_k,
         block_k=block_k,
         num_kv=num_kv,
         sm_scale=sm_scale,
-        quantized=False,
+        quantized=quantized,
         has_vf=has_vf,
     )
 
 
 def _chunk_kernel(pages_ref, q_ref, k_ref, v_ref, pos0_ref, *refs,
-                  block_k, num_kv, sm_scale, chunk, window=None):
+                  block_k, num_kv, sm_scale, chunk, window=None,
+                  quantized=False):
     """Chunk-query paged attention: q rows are a CHUNK of positions
     [pos0, pos0 + chunk) (GQA groups folded in, row = member*chunk + p)
     attending the paged window up to each row's own position — the
     per-row causal mask ``col <= pos0 + row % chunk``. One (kv_head)
     program streams the window's pages innermost with online-softmax
     scratch, exactly the decode kernel's discipline with a row-dependent
-    diagonal instead of a shared index."""
+    diagonal instead of a shared index. Quantized pools add chunked
+    (page/128, 128) f32 scale tiles applied to the score/probability
+    columns in VMEM (``_decode_kernel``'s fused-dequant discipline)."""
     del pages_ref  # consumed by the index_maps
+    refs = list(refs)
+    ksc_ref = refs.pop(0) if quantized else None
+    vsc_ref = refs.pop(0) if quantized else None
     o_ref, m_scr, l_scr, acc_scr = refs
     j = pl.program_id(1)
     gc = q_ref.shape[1]
@@ -219,6 +311,10 @@ def _chunk_kernel(pages_ref, q_ref, k_ref, v_ref, pos0_ref, *refs,
             )
             * sm_scale
         )  # (gc, block_k)
+        if quantized:
+            # One f32 scale per column of this page: factors out of the
+            # per-vector dot, applied to the small score row.
+            s = s * ksc_ref[0, 0].reshape(1, block_k)
         rows = jax.lax.broadcasted_iota(jnp.int32, (gc, block_k), 0) % chunk
         cols = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (gc, block_k), 1
@@ -237,8 +333,9 @@ def _chunk_kernel(pages_ref, q_ref, k_ref, v_ref, pos0_ref, *refs,
         p = jnp.exp(s - m_new)
         m_scr[...] = m_new
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = p * vsc_ref[0, 0].reshape(1, block_k) if quantized else p
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            pv, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -264,16 +361,32 @@ def paged_chunk_attention_reference(q, k_pool, v_pool, pages, pos0,
     """jnp oracle for the chunk-query kernel: gather the window, mask
     ``col <= pos0 + row % chunk`` (banded by ``window`` when set),
     softmax, weight. q is (1, kv_h, g*C, hd) GROUP-FOLDED (row =
-    member*C + position), pages (n,)."""
-    kvh, hd = k_pool.shape[1], k_pool.shape[3]
-    gather = lambda pool: jnp.moveaxis(pool[pages], 1, 0).reshape(
-        1, kvh, -1, hd
-    )
-    k, v = gather(k_pool), gather(v_pool)
+    member*C + position), pages (n,). Quantized ``(values, scales)``
+    pool pairs apply scales to the score/probability columns, in
+    ``decode_attention_reference``'s op order."""
+    quantized = isinstance(k_pool, tuple)
+    kv = pool_values(k_pool)
+    kvh, hd = kv.shape[1], kv.shape[3]
+
+    def gather(pool):
+        return jnp.moveaxis(pool[pages], 1, 0).reshape(
+            1, kvh, -1, pool.shape[3]
+        )
+
     sm = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
-    s = jnp.einsum(
-        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
-    ) * sm
+    if quantized:
+        k, ksc = gather(k_pool[0]), gather(k_pool[1])
+        v, vsc = gather(v_pool[0]), gather(v_pool[1])
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk",
+            q.astype(jnp.float32),
+            k.astype(jnp.float32),
+        ) * jnp.swapaxes(ksc, 2, 3) * sm
+    else:
+        k, v = gather(k_pool), gather(v_pool)
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) * sm
     rows = jnp.arange(q.shape[2]) % chunk
     cols = jnp.arange(k.shape[2])
     live = cols[None, :] <= pos0 + rows[:, None]
@@ -281,16 +394,20 @@ def paged_chunk_attention_reference(q, k_pool, v_pool, pages, pos0,
         live = live & (cols[None, :] > pos0 + rows[:, None] - window)
     s = jnp.where(live[None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
+    if quantized:
+        p = p * jnp.swapaxes(vsc, 2, 3)
     return jnp.einsum(
         "bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
     ).astype(q.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("chunk", "window"))
-def _chunk_impl(q, k_pool, v_pool, pages, pos0, chunk, window=None):
+def _chunk_impl(q, k_pool, v_pool, k_scales, v_scales, pages, pos0, chunk,
+                window=None):
     _, kvh, gc, hd = q.shape
     page = k_pool.shape[2]
     n = pages.shape[0]
+    quantized = k_scales is not None
     pad_g = (-gc) % 8
     if pad_g:
         q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_g), (0, 0)))
@@ -309,16 +426,31 @@ def _chunk_impl(q, k_pool, v_pool, pages, pos0, chunk, window=None):
         del h, j, pages_ref
         return (0,)
 
+    in_specs = [
+        pl.BlockSpec((1, gcp, hd), q_map, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
+        pl.BlockSpec((1,), smem_map, memory_space=pltpu.SMEM),
+    ]
+    operands = [qf, k_pool, v_pool, pos0v]
+    if quantized:
+        # Kernel arg order is q, k, v, pos0, THEN the scale tiles (the
+        # kernel pops them off *refs after the SMEM scalar); chunked
+        # (P/128, 128) scale views as in _paged_impl.
+        for s in (k_scales, v_scales):
+            operands.append(
+                s.reshape(s.shape[0], kvh, page // 128, 128)
+            )
+            in_specs.append(
+                pl.BlockSpec(
+                    (1, 1, page // 128, 128), kv_map, memory_space=_VMEM
+                )
+            )
     on_tpu = jax.default_backend() == "tpu"
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(kvh, n),
-        in_specs=[
-            pl.BlockSpec((1, gcp, hd), q_map, memory_space=_VMEM),
-            pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
-            pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
-            pl.BlockSpec((1,), smem_map, memory_space=pltpu.SMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, gcp, hd), q_map, memory_space=_VMEM),
         scratch_shapes=[
             pltpu.VMEM((gcp, 1), jnp.float32),
@@ -334,6 +466,7 @@ def _chunk_impl(q, k_pool, v_pool, pages, pos0, chunk, window=None):
             sm_scale=1.0 / (hd ** 0.5),
             chunk=chunk,
             window=window,
+            quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((kvh, gcp, hd), q.dtype),
@@ -345,7 +478,7 @@ def _chunk_impl(q, k_pool, v_pool, pages, pos0, chunk, window=None):
             else None
         ),
         interpret=not on_tpu,
-    )(jnp.asarray(pages, jnp.int32), qf, k_pool, v_pool, pos0v)
+    )(jnp.asarray(pages, jnp.int32), *operands)
     return out.reshape(1, kvh, gcp, hd)[:, :, :gc, :]
 
 
@@ -366,12 +499,14 @@ def paged_chunk_attention(
 
     q (1, kv_h, g*chunk, hd) group-folded; ``pages`` (n,) covers the
     whole live window [0, pos0 + chunk) (pow2 padding to the trash page
-    is fine — those positions are past every row's mask). Dispatch as
+    is fine — those positions are past every row's mask). Pools may be
+    quantized ``(int8 values, f32 scales)`` pairs. Dispatch as
     :func:`paged_attention`: kernel on real TPUs with lane-multiple
     pages, oracle elsewhere."""
-    check_head_parity(q.shape[1], k_pool.shape[1])
-    page = k_pool.shape[2]
-    supported = pltpu is not None and page % 128 == 0
+    quantized = isinstance(k_pool, tuple)
+    check_head_parity(q.shape[1], pool_values(k_pool).shape[1])
+    page = pool_values(k_pool).shape[2]
+    supported = _kernel_supported(page, quantized)
     if prefer is None:
         prefer = (
             "pallas"
@@ -383,7 +518,8 @@ def paged_chunk_attention(
             f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
         )
     if prefer == "pallas" and supported:
-        return _chunk_impl(q, k_pool, v_pool, pages, pos0, chunk, window)
+        kv, vv, ks, vs = _split_pools(k_pool, v_pool)
+        return _chunk_impl(q, kv, vv, ks, vs, pages, pos0, chunk, window)
     return paged_chunk_attention_reference(
         q, k_pool, v_pool, pages, pos0, chunk, window
     )
@@ -393,10 +529,11 @@ def paged_verify_attention_reference(q, k_pool, v_pool, page_table, index,
                                      chunk: int, window: int | None = None):
     """jnp oracle for the batched paged VERIFY: gather each slot's pages
     into a contiguous window and run the contiguous verify oracle
-    (``ops/decode_attention.verify_attention``) — per-row diagonal
-    ``col <= index[b] + row % chunk``. q (b, kv_h, g*chunk, hd)
-    group-folded K-major; ``index`` (b,) per-slot base positions
-    (negative = dead row, fully masked)."""
+    (``ops/decode_attention.verify_attention``, which owns the
+    quantized scale application for ``(int8 values, f32 scales)``
+    pools) — per-row diagonal ``col <= index[b] + row % chunk``. q
+    (b, kv_h, g*chunk, hd) group-folded K-major; ``index`` (b,)
+    per-slot base positions (negative = dead row, fully masked)."""
     from adapt_tpu.ops.decode_attention import verify_attention
 
     b = q.shape[0]
@@ -406,20 +543,31 @@ def paged_verify_attention_reference(q, k_pool, v_pool, page_table, index,
         g_ = jnp.moveaxis(g_, 2, 1)
         return g_.reshape(b, pool.shape[1], -1, pool.shape[3])
 
+    if isinstance(k_pool, tuple):
+        cache_k = (gather(k_pool[0]), gather(k_pool[1]))
+        cache_v = (gather(v_pool[0]), gather(v_pool[1]))
+    else:
+        cache_k, cache_v = gather(k_pool), gather(v_pool)
     return verify_attention(
-        q, gather(k_pool), gather(v_pool), index, chunk, window=window
+        q, cache_k, cache_v, index, chunk, window=window
     )
 
 
 def _verify_kernel(table_ref, q_ref, k_ref, v_ref, idx_ref, *refs,
-                   block_k, num_kv, sm_scale, chunk, window=None):
+                   block_k, num_kv, sm_scale, chunk, window=None,
+                   quantized=False):
     """Batched chunk-query paged attention: one (batch, kv_head) row of
     K-major verify rows streams ITS page-table row innermost (scalar
     prefetch, as ``_paged_kernel``) with ``_chunk_kernel``'s per-row
     diagonal mask anchored at this slot's OWN base position
     (``idx_ref`` SMEM) — the speculative verify over a paged cache.
-    Dead rows (negative index) skip every block and emit zeros."""
+    Dead rows (negative index) skip every block and emit zeros.
+    Quantized pools add chunked (page/128, 128) f32 scale tiles applied to the
+    score/probability columns in VMEM (the fused dequant)."""
     del table_ref  # consumed by the index_maps
+    refs = list(refs)
+    ksc_ref = refs.pop(0) if quantized else None
+    vsc_ref = refs.pop(0) if quantized else None
     o_ref, m_scr, l_scr, acc_scr = refs
     j = pl.program_id(1)
     gc = q_ref.shape[1]
@@ -441,6 +589,8 @@ def _verify_kernel(table_ref, q_ref, k_ref, v_ref, idx_ref, *refs,
             )
             * sm_scale
         )  # (gc, block_k)
+        if quantized:
+            s = s * ksc_ref[0, 0].reshape(1, block_k)
         rows = jax.lax.broadcasted_iota(jnp.int32, (gc, block_k), 0) % chunk
         cols = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (gc, block_k), 1
@@ -455,8 +605,9 @@ def _verify_kernel(table_ref, q_ref, k_ref, v_ref, idx_ref, *refs,
         p = jnp.exp(s - m_new)
         m_scr[...] = m_new
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = p * vsc_ref[0, 0].reshape(1, block_k) if quantized else p
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            pv, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -478,10 +629,12 @@ def _verify_kernel(table_ref, q_ref, k_ref, v_ref, idx_ref, *refs,
 
 
 @functools.partial(jax.jit, static_argnames=("chunk", "window"))
-def _verify_impl(q, k_pool, v_pool, page_table, index, chunk, window=None):
+def _verify_impl(q, k_pool, v_pool, k_scales, v_scales, page_table, index,
+                 chunk, window=None):
     b, kvh, gc, hd = q.shape
     page = k_pool.shape[2]
     pages_per_slot = page_table.shape[1]
+    quantized = k_scales is not None
     pad_g = (-gc) % 8
     if pad_g:
         q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_g), (0, 0)))
@@ -503,16 +656,29 @@ def _verify_impl(q, k_pool, v_pool, page_table, index, chunk, window=None):
         del j, table_ref
         return (bh,)
 
+    in_specs = [
+        pl.BlockSpec((1, gcp, hd), q_map, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
+        pl.BlockSpec((1,), smem_map, memory_space=pltpu.SMEM),
+    ]
+    operands = [qf, k_pool, v_pool, idx]
+    if quantized:
+        # Chunked (P/128, 128) scale views as in _paged_impl.
+        for s in (k_scales, v_scales):
+            operands.append(
+                s.reshape(s.shape[0], kvh, page // 128, 128)
+            )
+            in_specs.append(
+                pl.BlockSpec(
+                    (1, 1, page // 128, 128), kv_map, memory_space=_VMEM
+                )
+            )
     on_tpu = jax.default_backend() == "tpu"
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b * kvh, pages_per_slot),
-        in_specs=[
-            pl.BlockSpec((1, gcp, hd), q_map, memory_space=_VMEM),
-            pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
-            pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
-            pl.BlockSpec((1,), smem_map, memory_space=pltpu.SMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, gcp, hd), q_map, memory_space=_VMEM),
         scratch_shapes=[
             pltpu.VMEM((gcp, 1), jnp.float32),
@@ -528,6 +694,7 @@ def _verify_impl(q, k_pool, v_pool, page_table, index, chunk, window=None):
             sm_scale=1.0 / (hd ** 0.5),
             chunk=chunk,
             window=window,
+            quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * kvh, gcp, hd), q.dtype),
@@ -539,7 +706,7 @@ def _verify_impl(q, k_pool, v_pool, page_table, index, chunk, window=None):
             else None
         ),
         interpret=not on_tpu,
-    )(jnp.asarray(page_table, jnp.int32), qf, k_pool, v_pool, idx)
+    )(jnp.asarray(page_table, jnp.int32), *operands)
     return out.reshape(b, kvh, gcp, hd)[:, :, :gc, :]
 
 
@@ -558,16 +725,19 @@ def paged_verify_attention(
     rows per slot, each masked to its own ``index[b] + t`` diagonal;
     the caller has already scattered the chunk's K/V into the pages).
 
-    Dispatch as :func:`paged_attention`: the scalar-prefetch kernel on
-    a real TPU with lane-multiple pages (the gather oracle materializes
-    every slot's whole window — the traffic paging exists to avoid),
-    the oracle everywhere else. Grids and the GQA fold derive from the
-    shapes given — the per-shard head count under tensor parallelism —
-    so q and pool must carry the same head count
+    Pools are native arrays or quantized ``(int8 values, f32 scales)``
+    pairs (the caller scattered the chunk's quantized K/V into BOTH
+    members). Dispatch as :func:`paged_attention`: the scalar-prefetch
+    kernel on a real TPU with lane-multiple pages (the gather oracle
+    materializes every slot's whole window — the traffic paging exists
+    to avoid), the oracle everywhere else. Grids and the GQA fold
+    derive from the shapes given — the per-shard head count under
+    tensor parallelism — so q and pool must carry the same head count
     (``decode_attention.check_head_parity``)."""
-    check_head_parity(q.shape[1], k_pool.shape[1])
-    page = k_pool.shape[2]
-    supported = pltpu is not None and page % 128 == 0
+    quantized = isinstance(k_pool, tuple)
+    check_head_parity(q.shape[1], pool_values(k_pool).shape[1])
+    page = pool_values(k_pool).shape[2]
+    supported = _kernel_supported(page, quantized)
     if prefer is None:
         prefer = (
             "pallas"
@@ -579,8 +749,9 @@ def paged_verify_attention(
             f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
         )
     if prefer == "pallas" and supported:
+        kv, vv, ks, vs = _split_pools(k_pool, v_pool)
         return _verify_impl(
-            q, k_pool, v_pool, page_table, index, chunk, window
+            q, kv, vv, ks, vs, page_table, index, chunk, window
         )
     return paged_verify_attention_reference(
         q, k_pool, v_pool, page_table, index, chunk, window
@@ -598,6 +769,10 @@ def paged_attention(
 ) -> jax.Array:
     """Decode attention over a paged KV cache.
 
+    Pools are native arrays or ``(int8 values, f32 scales)`` pairs (one
+    scale per cached vector — the module-docstring layout); both pools
+    must agree on quantization.
+
     ``prefer``: None = auto — the kernel on a real TPU whenever the page
     size is a lane multiple (the gather oracle materializes the whole
     window, the exact traffic paging exists to avoid), the oracle
@@ -606,9 +781,10 @@ def paged_attention(
     ``prefer="pallas"``). ``"pallas"`` / ``"xla"`` force. Grids/folds
     derive from the given (per-shard, under TP) head count — q and pool
     must agree (``decode_attention.check_head_parity``)."""
-    check_head_parity(q.shape[1], k_pool.shape[1])
-    page = k_pool.shape[2]
-    supported = pltpu is not None and page % 128 == 0
+    quantized = isinstance(k_pool, tuple)
+    check_head_parity(q.shape[1], pool_values(k_pool).shape[1])
+    page = pool_values(k_pool).shape[2]
+    supported = _kernel_supported(page, quantized)
     if prefer is None:
         on_tpu = jax.default_backend() == "tpu"
         prefer = "pallas" if (supported and on_tpu) else "xla"
@@ -617,7 +793,10 @@ def paged_attention(
             f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
         )
     if prefer == "pallas" and supported:
-        return _paged_impl(q, k_pool, v_pool, page_table, index, valid_from)
+        kv, vv, ks, vs = _split_pools(k_pool, v_pool)
+        return _paged_impl(
+            q, kv, vv, ks, vs, page_table, index, valid_from
+        )
     return paged_attention_reference(
         q, k_pool, v_pool, page_table, index, valid_from
     )

@@ -34,6 +34,20 @@ def replicate(tree, mesh: Mesh):
     return jax.device_put(tree, sharding)
 
 
+def kv_head_sharding(mesh: Mesh, axis: str = "tp") -> NamedSharding:
+    """THE KV-cache placement under tensor parallelism: shard dim 1 —
+    the head axis — over ``axis``, leave everything else whole. One
+    spec serves every KV leaf the serving tier allocates, because they
+    all put heads on dim 1 by convention: dense slot strips
+    ``(slots, kv_heads, L, hd)``, paged pools
+    ``(pages, kv_heads, P, hd)``, and the int8 SCALE PLANES of
+    quantized caches/pools ``(..., kv_heads, ..., 1)`` — a quantized
+    cache is a ``(values, scales)`` pytree whose members must pin to
+    the SAME sharding or GSPMD reshards one of them mid-decode
+    (``runtime/continuous._shard_kv`` applies this spec per leaf)."""
+    return NamedSharding(mesh, P(None, axis))
+
+
 #: Tensor-parallel placement rules for the ViT encoder blocks
 #: (``models/vit.py``): megatron-style — qkv/mlp-in column-split over 'tp',
 #: attn-out/mlp-out row-split, so each block needs exactly one psum pair

@@ -37,9 +37,24 @@ serving, TPU-first:
   would have emitted for it alone — tested with staggered arrivals and
   mixed greedy/sampled traffic. Slot scheduling is invisible in outputs.
 
-``kv_cache_dtype="int8"`` stores slot caches quantized (absmax per K/V
-vector, the same scheme as ``generate``): ~2x the resident context per
-slot and ~2x less per-step cache traffic vs bf16 caches.
+``kv_cache_dtype="int8"`` stores KV caches quantized (absmax per K/V
+vector, the same scheme as ``generate``): ~2-4x the resident context
+per slot and proportionally less per-step cache traffic. Quantization
+is a CACHE-LAYOUT property, not a mode of one path — it composes with
+every layout and decode family: dense strips and paged pools both
+become ``(int8 values, f32 scales)`` pytree pairs (the scale plane is
+one f32 per vector, page-addressed by the same table, so prefix-shared
+pages carry their scales), speculative verify quantizes its
+multi-token appends through the same scheme, and under tensor
+parallelism both members head-shard together. Greedy quantized streams
+are bit-identical to the same-quantized solo
+``generate(kv_cache_dtype="int8")`` on the whole-prompt prefill paths;
+prefix-cache suffix passes and chunked prefill attend the
+already-quantized earlier window (there is no native copy), so those
+admissions carry the cache's quantization error into the first
+token's logits — the same class of fine print as chunk fp contraction
+widths, one quantization step coarser (tested via top-1-agreement
+bounds vs fp32 rather than exact equality).
 
 ``kv_layout="paged"`` swaps the per-slot ``max_len`` strips for a shared
 page POOL (``runtime/paged`` allocator + ``ops/paged_attention``'s
@@ -188,7 +203,12 @@ from adapt_tpu.models.transformer_lm import (
     nucleus_filter,
     validate_tp,
 )
-from adapt_tpu.parallel.sharding import lm_tp_rules, tree_shardings
+from adapt_tpu.ops.quantize import dequantize_params, quantize_params
+from adapt_tpu.parallel.sharding import (
+    kv_head_sharding,
+    lm_tp_rules,
+    tree_shardings,
+)
 from adapt_tpu.runtime.paged import Pager, insert_prefill_pages
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
@@ -356,8 +376,10 @@ class ContinuousBatcher:
                 #: KV caches shard on the HEAD axis (dim 1 of both the
                 #: dense (slots, kvh, L, hd) strips and the paged
                 #: (pages, kvh, P, hd) pools — and of the int8 scale
-                #: planes).
-                self._kv_sharding = NamedSharding(mesh, P(None, axis))
+                #: planes: both members of a quantized (values, scales)
+                #: pair pin to the SAME spec, parallel.sharding's one
+                #: definition).
+                self._kv_sharding = kv_head_sharding(mesh, axis)
                 variables = jax.device_put(
                     variables,
                     tree_shardings(
@@ -395,13 +417,14 @@ class ContinuousBatcher:
                     f"draft max_len {draft_lm.max_len} < target max_len "
                     f"{lm.max_len}"
                 )
-            if kv_cache_dtype != "native":
-                raise ValueError(
-                    "speculative mode requires kv_cache_dtype='native' "
-                    "(the verify chunk appends native K/V; int8 verify "
-                    "is future work)"
-                )
             self._spec = speculative or SpeculativeConfig()
+            if self._spec.draft_weight_dtype == "int8":
+                # Store the draft's matrix weights blockwise int8
+                # (replicated under TP, so this is a direct per-chip
+                # HBM cut); the draft programs dequantize at use
+                # (draft_chunk / _draft_prefill_fn), so the f32 weights
+                # never persist.
+                draft_variables = quantize_params(draft_variables)
         else:
             self._spec = None
         self._spec_k = self._spec.draft_k if self._spec else 0
@@ -416,15 +439,14 @@ class ContinuousBatcher:
             raise ValueError(
                 f"kv_layout={kv_layout!r}: expected 'slots' or 'paged'"
             )
-        if kv_layout == "paged" and kv_cache_dtype == "int8":
-            raise ValueError(
-                "kv_layout='paged' supports native caches only (int8 "
-                "pools are future work — see ops/paged_attention); both "
-                "are capacity knobs, pick one"
-            )
-        #: int8 slot caches: absmax per K/V vector, same scheme as
-        #: generate(kv_cache_dtype="int8") — ~2x more resident context
-        #: per slot and ~2x less per-step cache traffic vs bf16.
+        #: int8 KV caches: absmax per K/V vector, same scheme as
+        #: generate(kv_cache_dtype="int8") — ~2-4x more resident context
+        #: per slot and ~2-4x less per-step cache traffic vs native.
+        #: Composes with EVERY layout and mode: dense strips and paged
+        #: pools both become (int8 values, f32 scales) pytree pairs,
+        #: speculative verify quantizes its multi-token appends, and
+        #: under TP both members head-shard together — quantization is
+        #: a cache-layout property, not a special mode of one path.
         self._kv_quant = kv_cache_dtype == "int8"
         #: paged caches: per-block page POOLS + a shared page table
         #: (``runtime/paged`` allocator, ``ops/paged_attention`` kernel)
@@ -488,6 +510,19 @@ class ContinuousBatcher:
             self._pool_pages = pool_pages
 
             def one_cache():
+                if self._kv_quant:
+                    # (values, scales) POOL pair: the scale plane is one
+                    # f32 per cached vector, page-addressed by the SAME
+                    # table — prefix-shared pages carry their scales.
+                    return (
+                        jnp.zeros(
+                            (pool_pages, heads, page_size, head_dim),
+                            jnp.int8,
+                        ),
+                        jnp.zeros(
+                            (pool_pages, heads, page_size, 1), jnp.float32
+                        ),
+                    )
                 return jnp.zeros(
                     (pool_pages, heads, page_size, head_dim), block0.dtype
                 )
@@ -515,6 +550,22 @@ class ContinuousBatcher:
             # Head-sharded KV: each device holds kv_heads / tp of every
             # slot strip (or pool page) — THE capacity win TP buys.
             self._caches = jax.device_put(self._caches, self._kv_sharding)
+        #: What the SAME cache geometry would cost in the native dtype
+        #: — the denominator of the memory.kv_bytes_ratio gauge, so the
+        #: int8 capacity win (values + scale planes vs native) is
+        #: directly observable on dashboards. Native batchers read 1.0.
+        if self._paged:
+            cache_positions = pool_pages * page_size
+        else:
+            cache_positions = slots * self._cache_len
+        self._native_cache_bytes = (
+            2
+            * len(lm.block_names)
+            * cache_positions
+            * heads
+            * head_dim
+            * jnp.dtype(block0.dtype).itemsize
+        )
         #: Idle-row cache position: slot layout parks garbage writes at
         #: the trash strip; paged layout uses a negative sentinel that
         #: stays negative across a whole tick's position advance
@@ -967,13 +1018,18 @@ class ContinuousBatcher:
 
     def _insert_paged(self, caches, pages, kvs):
         """Scatter a prefilled request's per-block K/V into its pages
-        (``runtime/paged.insert_prefill_pages`` per pool)."""
+        (``runtime/paged.insert_prefill_pages`` per pool). tree.map
+        reaches the (values, scales) members of quantized pools and the
+        plain arrays of native ones alike — the scale plane scatters by
+        the same page list, so the pages' scales always travel with
+        their int8 values (prefix sharing included)."""
         return [
-            (
-                insert_prefill_pages(kp, pages, ck),
-                insert_prefill_pages(vp, pages, cv),
+            jax.tree.map(
+                lambda pool, kv: insert_prefill_pages(pool, pages, kv),
+                c_pair,
+                n_pair,
             )
-            for (kp, vp), (ck, cv) in zip(caches, kvs)
+            for c_pair, n_pair in zip(caches, kvs)
         ]
 
     def _first_pick(self, h_last, variables, keys, temp, top_k, top_p,
@@ -1088,13 +1144,16 @@ class ContinuousBatcher:
         """Jitted DRAFT prefill for one prompt bucket: full causal
         forward over (1, bucket), per-block K/V to insert into the
         draft's dense slot strips. No sampling tail — the draft never
-        emits; it only seeds its cache for the per-tick draft scan."""
+        emits; it only seeds its cache for the per-tick draft scan.
+        int8 draft weights (``draft_weight_dtype``) dequantize inside
+        the jit, mirroring ``draft_chunk``."""
         key = ("draft", bucket)
         if key in self._prefill_cache:
             return self._prefill_cache[key]
 
         @jax.jit
         def dprefill(variables, ids):
+            variables = dequantize_params(variables)
             h = self._draft_embed.apply(variables["embed"], ids)
             kvs = []
             for name, block in zip(
@@ -2004,6 +2063,13 @@ class ContinuousBatcher:
                     device_local_nbytes(x)
                     for x in jax.tree.leaves(self._caches)
                 ),
+                # Quantized ÷ native-equivalent cache bytes (scale
+                # planes counted): the honest capacity multiplier —
+                # 1.0 for native caches, (hd + 4) / (hd * itemsize)
+                # for int8 + f32-scale ones.
+                "cache_bytes_ratio": sum(
+                    x.nbytes for x in jax.tree.leaves(self._caches)
+                ) / float(self._native_cache_bytes),
                 "tp": self._tp,
             }
             if self._spec is not None:
@@ -2048,7 +2114,12 @@ class ContinuousBatcher:
           (``paged.prefix_{hits,misses,capacity_skips}``);
         - speculative mode: ``memory.draft_cache_bytes`` (the draft
           replicates under TP, so its per-device bytes ARE its logical
-          bytes).
+          bytes);
+        - both layouts: ``memory.kv_bytes_ratio`` — actual cache bytes
+          (scale planes INCLUDED) over what the same geometry would
+          cost in the native dtype. 1.0 native; ~(hd + 4)/(hd *
+          itemsize) quantized — the 2-4x capacity win as a dashboard
+          number.
         """
         cache_bytes = float(
             sum(x.nbytes for x in jax.tree.leaves(self._caches))
@@ -2078,6 +2149,9 @@ class ContinuousBatcher:
         else:
             out["memory.kv_bytes"] = cache_bytes
             out["memory.kv_bytes_per_device"] = per_device
+        out["memory.kv_bytes_ratio"] = cache_bytes / float(
+            self._native_cache_bytes
+        )
         if self._draft_caches is not None:
             out["memory.draft_cache_bytes"] = float(
                 sum(x.nbytes for x in jax.tree.leaves(self._draft_caches))

@@ -31,6 +31,15 @@ page — the table, the free list, refcounts and prefix keys are logical
 bookkeeping, identical on every shard, so the allocator never changes
 with the mesh (``table()`` is uploaded replicated).
 
+Quantization is equally invisible: an int8 batcher keeps TWO pools per
+K/V (``(int8 values, f32 scales)`` — ``ops/paged_attention``'s
+quantized layout) addressed by ONE page id space, so every allocator
+decision (alloc/free/recycle/prefix-share) applies to a page's values
+and its scale plane atomically — a prefix-shared page always carries
+the scales its int8 payload was written with. ``insert_prefill_pages``
+scatters either member (``kv`` trailing dim is head_dim for values, 1
+for scale planes).
+
 No reference analog (SURVEY.md §2.2) — serving-memory frontier.
 """
 

@@ -128,6 +128,89 @@ def test_paged_verify_kernel_matches_oracle(rng):
         )
 
 
+def _quantized_pool(key, npages, kvh, page, hd):
+    """Random native pool quantized with THE shared per-vector scheme —
+    (int8 values, f32 scales (npages, kvh, page, 1)) pair."""
+    from adapt_tpu.ops.quantize import quantize_kv_vectors
+
+    return quantize_kv_vectors(
+        jax.random.normal(key, (npages, kvh, page, hd))
+    )
+
+
+def test_paged_kernel_quantized_matches_oracle(rng):
+    """Quantized ``_paged_kernel``: scale tiles ride the scalar-prefetch
+    pipeline (table-addressed like the int8 payload) into the shared
+    ``_decode_kernel`` quantized branch — interpreter parity vs the
+    gather oracle (which itself reduces to the contiguous quantized
+    decode oracle), with and without ragged valid_from."""
+    b, kvh, g, hd, page, npages = 2, 2, 3, 64, 128, 16
+    q = jax.random.normal(rng, (b, kvh, g, hd))
+    kp = _quantized_pool(jax.random.fold_in(rng, 1), npages, kvh, page, hd)
+    vp = _quantized_pool(jax.random.fold_in(rng, 2), npages, kvh, page, hd)
+    table = jnp.asarray([[3, 7, 1, 0], [5, 2, 9, 4]], jnp.int32)
+    index = jnp.asarray([300, 200], jnp.int32)
+    for vf in (None, jnp.asarray([10, 0], jnp.int32)):
+        ref = paged_attention_reference(q, kp, vp, table, index, vf)
+        out = paged_attention(q, kp, vp, table, index, vf, prefer="pallas")
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
+def test_paged_verify_kernel_quantized_matches_oracle(rng):
+    """Quantized ``_verify_kernel`` (the int8 speculative verify over a
+    paged cache): desynchronized per-slot indices, GQA folding, sliding
+    window — interpreter parity vs the gather oracle."""
+    from adapt_tpu.ops.paged_attention import (
+        paged_verify_attention,
+        paged_verify_attention_reference,
+    )
+
+    b, kvh, g, chunk, hd, page, npages = 2, 2, 2, 5, 64, 128, 16
+    q = jax.random.normal(rng, (b, kvh, g * chunk, hd))
+    kp = _quantized_pool(jax.random.fold_in(rng, 1), npages, kvh, page, hd)
+    vp = _quantized_pool(jax.random.fold_in(rng, 2), npages, kvh, page, hd)
+    table = jnp.asarray([[3, 7, 1, 0], [5, 2, 9, 4]], jnp.int32)
+    index = jnp.asarray([301, 77], jnp.int32)
+    for window in (None, 130):
+        ref = paged_verify_attention_reference(
+            q, kp, vp, table, index, chunk, window=window
+        )
+        out = paged_verify_attention(
+            q, kp, vp, table, index, chunk, prefer="pallas", window=window
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg=f"window={window}",
+        )
+
+
+def test_paged_chunk_kernel_quantized_matches_oracle(rng):
+    """Quantized ``_chunk_kernel`` (int8 chunked prefill): the chunk's
+    rows attend the quantized window with fused scale application —
+    interpreter parity vs the gather oracle, incl. trash padding."""
+    from adapt_tpu.ops.paged_attention import (
+        paged_chunk_attention,
+        paged_chunk_attention_reference,
+    )
+
+    kvh, g, chunk, hd, page, npages = 2, 3, 32, 64, 128, 12
+    q = jax.random.normal(rng, (1, kvh, g * chunk, hd))
+    kp = _quantized_pool(jax.random.fold_in(rng, 1), npages, kvh, page, hd)
+    vp = _quantized_pool(jax.random.fold_in(rng, 2), npages, kvh, page, hd)
+    for pos0, pages in [(128, [3, 7, 0, 0]), (0, [5, 0])]:
+        pages = jnp.asarray(pages, jnp.int32)
+        ref = paged_chunk_attention_reference(q, kp, vp, pages, pos0, chunk)
+        out = paged_chunk_attention(
+            q, kp, vp, pages, pos0, chunk, prefer="pallas"
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg=f"pos0={pos0}",
+        )
+
+
 def test_paged_kernel_unsupported_page_size_falls_back(rng):
     # page 16 is not a lane multiple: prefer="pallas" serves the oracle.
     b, kvh, g, hd, page, npages = 1, 2, 1, 64, 16, 8
@@ -471,10 +554,12 @@ def test_paged_validation(lm_setup):
     lm, variables = lm_setup
     with pytest.raises(ValueError, match="kv_layout"):
         ContinuousBatcher(lm, variables, kv_layout="vram")
-    with pytest.raises(ValueError, match="native caches only"):
-        ContinuousBatcher(
-            lm, variables, kv_layout="paged", kv_cache_dtype="int8"
-        )
+    # Paged + int8 is a supported COMPOSITION (tests/test_quant_serving
+    # pins its behavior); construction must succeed with pool pairs.
+    q = ContinuousBatcher(
+        lm, variables, slots=2, kv_layout="paged", kv_cache_dtype="int8"
+    )
+    assert isinstance(q._caches[0][0], tuple)  # (int8 values, f32 scales)
     bat = ContinuousBatcher(
         lm, variables, slots=2, kv_layout="paged", page_size=16,
         pool_pages=2,  # one allocatable page = 16 positions
