@@ -179,6 +179,39 @@ class SpeculativeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """Per-request latency budget, evaluated by the serving tier's
+    existing lifecycle stamps (``runtime/continuous`` request
+    timelines; ``docs/OBSERVABILITY.md`` "Workload telemetry").
+
+    ``ContinuousBatcher.submit(..., slo=SLOSpec(...))`` attaches one to
+    a request: TTFT is judged once at the first emitted token
+    (submit -> first token, queue wait included — the user-visible
+    number), ITL at every subsequent commit. A request stays "inside
+    budget" until its first violation; tokens committed while inside
+    budget count toward ``continuous.goodput_tokens_s``, and the
+    request lands in its tenant's ``slo.met_total.<tenant>`` /
+    ``slo.missed_total.<tenant>`` counter at finish. Evaluation rides
+    the ``obs_timeline`` gate: host-side arithmetic on stamps already
+    taken — zero extra device traffic, zero compiled-program impact."""
+
+    #: Submit -> first emitted token budget (None = no TTFT budget).
+    ttft_budget_s: float | None = None
+    #: Inter-token budget between consecutive commits (None = none).
+    itl_budget_s: float | None = None
+    #: Accounting label for the per-tenant met/missed counters.
+    tenant: str = "default"
+
+    def __post_init__(self):
+        for name in ("ttft_budget_s", "itl_budget_s"):
+            v = getattr(self, name)
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} must be > 0, got {v}")
+        if not self.tenant:
+            raise ValueError("tenant must be a non-empty label")
+
+
+@dataclasses.dataclass(frozen=True)
 class ObservabilityConfig:
     """Tracing + flight-recorder knobs (``utils.tracing``, served by
     ``utils.exporter``). The flight recorder is ALWAYS on (bounded ring,

@@ -118,6 +118,24 @@ disables the histograms; flight-recorder lifecycle events
 (admit/finish/cancel — per-request, not per-token) are always on, and
 spans (prefill, decode chunk) additionally require the global tracer.
 
+**SLO tracking + goodput** (``docs/OBSERVABILITY.md`` "Workload
+telemetry"): ``submit(slo=config.SLOSpec(ttft_budget_s=...,
+itl_budget_s=..., tenant=...))`` attaches a latency budget that the
+SAME lifecycle stamps evaluate — TTFT once at the first emitted token,
+ITL at each later commit. Results feed ``slo.ttft_attainment`` /
+``slo.itl_attainment`` gauges, ``slo.{ttft,itl}_{met,missed}_total``
+counters, per-tenant ``slo.{met,missed}_total.<tenant>`` request
+verdicts at finish, one ``slo_missed`` flight event at a request's
+FIRST violation, and ``continuous.goodput_tokens_s`` — tokens/s from
+requests still inside budget over a rolling window
+(``goodput_window_s``), next to cumulative
+``continuous.{tokens,good_tokens}_total`` counters for windowed
+phase deltas. All of it is host arithmetic on stamps already taken,
+flushed to the registry once per tick: zero extra h2d transfers, zero
+compiled-program impact, and ``obs_timeline=False`` one-branch-disables
+it with the rest of the timeline. ``benchmarks/load`` drives this
+instrumentation into goodput-vs-offered-load curves.
+
 **Batched speculative decoding** (``draft_lm=``/``draft_variables=`` +
 ``config.SpeculativeConfig``): every serving tick becomes a fixed-shape
 ``draft_k + 1``-step draft scan over ALL slots
@@ -195,7 +213,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from adapt_tpu.config import ParallelConfig, SpeculativeConfig
+from adapt_tpu.config import ParallelConfig, SLOSpec, SpeculativeConfig
 from adapt_tpu.models.speculative import accept_speculation, draft_chunk
 from adapt_tpu.models.transformer_lm import (
     TransformerLM,
@@ -217,8 +235,11 @@ from adapt_tpu.utils.profiling import (
     device_local_nbytes,
     global_compile_sentinel,
     global_engine_obs,
+    program_cost_analysis,
     register_memory_source,
+    register_roofline_source,
     unregister_memory_source,
+    unregister_roofline_source,
 )
 from adapt_tpu.utils.tracing import global_flight_recorder, global_tracer
 
@@ -257,6 +278,10 @@ class _Request:
     #: Lifecycle anchor (perf-counter clock, stamped by submit):
     #: queue-wait, TTFT and request latency all measure from here.
     t_submit: float = 0.0
+    #: Optional latency budget (``config.SLOSpec``): TTFT judged at the
+    #: first emitted token, ITL per commit; evaluation rides the
+    #: obs_timeline gate.
+    slo: SLOSpec | None = None
 
 
 @dataclasses.dataclass
@@ -285,6 +310,10 @@ class _Slot:
     t_first: float = 0.0
     t_last: float = 0.0
     obs_count: int = 0
+    #: SLO state: True until the request's first budget violation —
+    #: only its tokens count toward goodput (requests with no SLOSpec
+    #: have nothing to violate and stay True).
+    slo_ok: bool = True
 
 
 class ContinuousBatcher:
@@ -681,6 +710,32 @@ class ContinuousBatcher:
         #: this flag.
         self.obs_timeline = True
         self._itl_pending: list[float] = []
+        #: SLO accounting (docs/OBSERVABILITY.md "Workload telemetry").
+        #: Hot path touches only these plain ints (one attribute inc
+        #: per evaluated stamp); the registry sees them once per tick
+        #: in _obs_flush. Keys: ttft_met/ttft_missed/itl_met/itl_missed
+        #: (this tick's pending) and the instance-lifetime mirrors.
+        self._slo_pending = {
+            "ttft_met": 0, "ttft_missed": 0,
+            "itl_met": 0, "itl_missed": 0,
+        }
+        self._slo_totals = {
+            "ttft_met": 0, "ttft_missed": 0,
+            "itl_met": 0, "itl_missed": 0,
+        }
+        #: Committed tokens this tick (all, and from requests still
+        #: inside budget) — flushed as continuous.{tokens,good_tokens}
+        #: counters and folded into the goodput gauge.
+        self._tick_tokens = 0
+        self._tick_good_tokens = 0
+        #: Rolling (t, good_tokens) per-tick samples spanning
+        #: goodput_window_s — continuous.goodput_tokens_s is their rate
+        #: (idle ticks append zeros, so the gauge decays instead of
+        #: scraping the last busy tick's rate forever).
+        self.goodput_window_s = 2.0
+        self._goodput_samples: collections.deque[tuple[float, int]] = (
+            collections.deque()
+        )
         #: Engine-tier observability (utils.profiling): per-phase tick
         #: timing behind the process-global EngineObs gate (one branch
         #: per phase when off), plus the compile sentinel sampled once
@@ -719,6 +774,12 @@ class ContinuousBatcher:
         #: bytes and paged occupancy served as memory.* gauges at every
         #: exporter scrape (weakly held — see utils.profiling).
         register_memory_source("continuous", self)
+        #: Roofline source: XLA cost_analysis of the decode-path
+        #: programs (lazy, cached — see _program_costs) + the engine
+        #: phase walls, served as engine.{flops,bytes_accessed,mbu,mfu}
+        #: gauges at scrape.
+        self._roofline_costs: dict | None = None
+        register_roofline_source("continuous", self)
         # Threaded serving (start()/result()/stop()): one condition
         # guards every mutation of the queue/done handoff state and the
         # server-thread lifecycle; compiled work runs outside the lock,
@@ -1215,8 +1276,16 @@ class ContinuousBatcher:
         rng: jax.Array | None = None,
         stop: list | None = None,
         on_token: Callable[[int, int, int], None] | None = None,
+        slo: SLOSpec | None = None,
     ) -> int:
-        """Queue one request; returns its id. ``on_token`` (optional
+        """Queue one request; returns its id. ``slo`` (optional
+        ``config.SLOSpec``) attaches a latency budget: TTFT is judged
+        once at the first emitted token, ITL at every later commit,
+        feeding the ``slo.*`` attainment metrics, the per-tenant
+        met/missed counters and ``continuous.goodput_tokens_s``
+        (evaluation rides the ``obs_timeline`` gate — host arithmetic
+        on stamps already taken, nothing device-side).
+        ``on_token`` (optional
         ``callable(req_id, token, index)``) streams each committed
         token as it lands — invoked on the TICKING thread at commit
         time (chunk granularity: up to ``chunk`` callbacks per tick),
@@ -1278,20 +1347,40 @@ class ContinuousBatcher:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if stop is not None and any(len(seq) == 0 for seq in stop):
             raise ValueError("stop sequences must be non-empty")
-        # generate()'s exact schedule: split -> key0 + per-step keys, each
-        # folded with the row index (0 — solo semantics). One vmapped
-        # dispatch + one host fetch, not O(steps) of them — this runs on
-        # the serving control path.
-        rng_next, key0 = jax.random.split(rng)
-        if steps > 1:
-            step_keys = jnp.concatenate(
-                [key0[None], jax.random.split(rng_next, steps - 1)]
+        if slo is not None and not isinstance(slo, SLOSpec):
+            raise TypeError(
+                f"slo must be a config.SLOSpec, got {type(slo).__name__}"
+            )
+        if do_sample:
+            # generate()'s exact schedule: split -> key0 + per-step
+            # keys, each folded with the row index (0 — solo
+            # semantics). One vmapped dispatch + one host fetch, not
+            # O(steps) of them — this runs on the serving control path.
+            rng_next, key0 = jax.random.split(rng)
+            if steps > 1:
+                step_keys = jnp.concatenate(
+                    [key0[None], jax.random.split(rng_next, steps - 1)]
+                )
+            else:
+                step_keys = key0[None]
+            folded = np.asarray(
+                jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+                    step_keys, 0
+                )
             )
         else:
-            step_keys = key0[None]
-        folded = np.asarray(
-            jax.vmap(jax.random.fold_in, in_axes=(0, None))(step_keys, 0)
-        )
+            # Greedy requests never read a sampling key (the step's
+            # sampled pick is discarded by ``jnp.where(greedy, ...)``,
+            # and the first-token tail does the same), so skip the
+            # schedule build entirely: one zero key row stages as the
+            # whole schedule (nkeys=1; the cursor clips to it). This
+            # matters beyond tidiness: ``split(rng, steps-1)`` compiles
+            # one variant PER DISTINCT steps VALUE, so a greedy load
+            # with heavy-tailed output lengths (benchmarks/load) was
+            # paying an XLA compile on the submit path for every new
+            # length — a multi-second stall of the tick loop that
+            # measured as fake ITL.
+            folded = np.zeros((1, 2), np.uint32)
         with self._cv:
             req_id = self._next_id
             self._next_id += 1
@@ -1317,6 +1406,7 @@ class ContinuousBatcher:
             ),
             on_token=on_token,
             t_submit=time.perf_counter(),
+            slo=slo,
         )
         with self._cv:
             self._queue.append(req)
@@ -1368,6 +1458,81 @@ class ContinuousBatcher:
             )
             return True
 
+    def _slo_violation(
+        self, slot: _Slot, budget: str, budget_s: float, measured_s: float
+    ) -> None:
+        """First budget violation flips the request OUT of goodput and
+        records ONE ``slo_missed`` flight event (per-request-lifecycle
+        grade, like admit/finish — later violations of an
+        already-missed request only move the attainment counters)."""
+        if slot.slo_ok:
+            slot.slo_ok = False
+            global_flight_recorder().record(
+                "slo_missed",
+                request=slot.req.req_id,
+                tenant=slot.req.slo.tenant,
+                budget=budget,
+                budget_s=budget_s,
+                measured_s=round(measured_s, 6),
+            )
+
+    def _obs_flush(self) -> None:
+        """Per-tick registry flush of the timeline/SLO bookkeeping the
+        commit path accumulated as plain attributes: the batched ITL
+        samples, the SLO attainment counters + gauges, the goodput
+        token counters and the windowed ``continuous.goodput_tokens_s``
+        rate. ONE call per tick (idle ticks included, so goodput decays
+        to zero instead of scraping the last busy rate forever); costs
+        a handful of registry-lock holds, inside the obs budget
+        (benchmarks/micro/obs_overhead.py)."""
+        reg = global_metrics()
+        if self._itl_pending:
+            reg.observe_many("continuous.itl_s", self._itl_pending)
+            self._itl_pending = []
+        pend = self._slo_pending
+        if any(pend.values()):
+            tot = self._slo_totals
+            for key, n in pend.items():
+                if n:
+                    tot[key] += n
+                    reg.inc(f"slo.{key}_total", float(n))
+                    pend[key] = 0
+            den = tot["ttft_met"] + tot["ttft_missed"]
+            if den:
+                reg.set_gauge(
+                    "slo.ttft_attainment", tot["ttft_met"] / den
+                )
+            den = tot["itl_met"] + tot["itl_missed"]
+            if den:
+                reg.set_gauge(
+                    "slo.itl_attainment", tot["itl_met"] / den
+                )
+        if self._tick_tokens:
+            reg.inc("continuous.tokens_total", float(self._tick_tokens))
+        if self._tick_good_tokens:
+            reg.inc(
+                "continuous.good_tokens_total",
+                float(self._tick_good_tokens),
+            )
+        # Windowed goodput rate: per-tick (t, good) samples spanning
+        # goodput_window_s. The gauge is tokens-inside-budget per
+        # second over that window — the "graceful degradation under
+        # overload" number the load harness sweeps.
+        now = time.perf_counter()
+        gs = self._goodput_samples
+        gs.append((now, self._tick_good_tokens))
+        self._tick_tokens = 0
+        self._tick_good_tokens = 0
+        cutoff = now - self.goodput_window_s
+        while len(gs) > 1 and gs[0][0] < cutoff:
+            gs.popleft()
+        span = now - gs[0][0]
+        if span > 0:
+            # gs[0] anchors the window start; its tokens were counted
+            # by the PREVIOUS span, so the rate sums the later samples.
+            good = sum(g for _, g in list(gs)[1:])
+            reg.set_gauge("continuous.goodput_tokens_s", good / span)
+
     def _finish(self, slot: _Slot, reason: str = "completed") -> None:
         req = slot.req
         if self.obs_timeline:
@@ -1375,6 +1540,13 @@ class ContinuousBatcher:
                 "continuous.request_latency_s",
                 time.perf_counter() - req.t_submit,
             )
+            if req.slo is not None:
+                # Request-level verdict for the tenant books: met =
+                # finished with every evaluated budget inside limits.
+                kind = "met" if slot.slo_ok else "missed"
+                global_metrics().inc(
+                    f"slo.{kind}_total.{req.slo.tenant}"
+                )
         # Flight events stay UNGATED like cancel's: the recorder's
         # contract is always-on per-lifecycle — a post-mortem must not
         # show cancels for requests with no admit/finish.
@@ -1405,6 +1577,7 @@ class ContinuousBatcher:
             slot.tokens = []
             slot.lps = []
             slot.pf_done = -1
+            slot.slo_ok = True
             if self._paged:
                 # Pages return to the pool the moment the request
                 # retires — the capacity win continuous paging exists
@@ -1445,13 +1618,40 @@ class ContinuousBatcher:
             if slot.t_first == 0.0:
                 slot.t_first = now
                 if emitted_before == 0:
-                    global_metrics().observe(
-                        "continuous.ttft_s", now - req.t_submit
-                    )
+                    ttft = now - req.t_submit
+                    global_metrics().observe("continuous.ttft_s", ttft)
+                    if req.slo is not None and (
+                        req.slo.ttft_budget_s is not None
+                    ):
+                        if ttft <= req.slo.ttft_budget_s:
+                            self._slo_pending["ttft_met"] += 1
+                        else:
+                            self._slo_pending["ttft_missed"] += 1
+                            self._slo_violation(
+                                slot, "ttft", req.slo.ttft_budget_s, ttft
+                            )
             elif slot.obs_count == emitted_before:
-                self._itl_pending.append(now - slot.t_last)
+                gap = now - slot.t_last
+                self._itl_pending.append(gap)
+                if req.slo is not None and (
+                    req.slo.itl_budget_s is not None
+                ):
+                    if gap <= req.slo.itl_budget_s:
+                        self._slo_pending["itl_met"] += 1
+                    else:
+                        self._slo_pending["itl_missed"] += 1
+                        self._slo_violation(
+                            slot, "itl", req.slo.itl_budget_s, gap
+                        )
             slot.t_last = now
             slot.obs_count = emitted_before + 1
+            # Goodput accounting: every committed token, split by
+            # whether its request is still inside budget (no-SLO
+            # requests have nothing to violate and stay good). Plain
+            # int incs here; the registry sees one flush per tick.
+            self._tick_tokens += 1
+            if slot.slo_ok:
+                self._tick_good_tokens += 1
         slot.tokens.append(token)
         slot.lps.append(lp)
         if req.on_token is not None:
@@ -1615,6 +1815,7 @@ class ContinuousBatcher:
             slot.lps = []
             slot.t_first = 0.0  # timeline: no token emitted yet
             slot.obs_count = 0
+            slot.slo_ok = True
             slot.pf_done = m * self._page if chunked else -1
             with self._cv:
                 self._admitting = None  # slot-bound: visible to cancel()
@@ -1928,6 +2129,11 @@ class ContinuousBatcher:
             "continuous.h2d_transfers", float(self._h2d_count)
         )
         if not active:
+            if self.obs_timeline:
+                # Idle ticks still flush (first-token commits from an
+                # admission whose request finished in one step, goodput
+                # decay toward zero).
+                self._obs_flush()
             self._sentinel.sample(write_gauges=False)
             return 0
         tracer = global_tracer()
@@ -2008,13 +2214,12 @@ class ContinuousBatcher:
                 ) // self._page - self._pager.base(slot.idx)
                 if dead > 0:
                     self._pager.release_prefix(slot.idx, dead)
-        # Flush the tick's inter-token-latency samples in ONE registry
-        # lock acquisition (not one per committed token).
-        if self._itl_pending:
-            global_metrics().observe_many(
-                "continuous.itl_s", self._itl_pending
-            )
-            self._itl_pending = []
+        # Flush the tick's timeline/SLO bookkeeping in O(1) registry
+        # lock acquisitions (not one per committed token): batched ITL
+        # samples, SLO attainment counters/gauges, goodput counters +
+        # windowed rate gauge.
+        if self.obs_timeline:
+            self._obs_flush()
         # Post-commit occupancy: slots retired by this chunk are gone.
         global_metrics().set_gauge(
             "continuous.active_slots",
@@ -2071,6 +2276,12 @@ class ContinuousBatcher:
                     x.nbytes for x in jax.tree.leaves(self._caches)
                 ) / float(self._native_cache_bytes),
                 "tp": self._tp,
+                # SLO attainment books (instance-lifetime, flushed
+                # per tick — mirrors of the slo.* registry counters).
+                "slo_ttft_met": self._slo_totals["ttft_met"],
+                "slo_ttft_missed": self._slo_totals["ttft_missed"],
+                "slo_itl_met": self._slo_totals["itl_met"],
+                "slo_itl_missed": self._slo_totals["itl_missed"],
             }
             if self._spec is not None:
                 out["spec_drafted"] = self._spec_drafted
@@ -2156,6 +2367,70 @@ class ContinuousBatcher:
             out["memory.draft_cache_bytes"] = float(
                 sum(x.nbytes for x in jax.tree.leaves(self._draft_caches))
             )
+        return out
+
+    def _program_costs(self) -> dict[str, dict[str, float]]:
+        """Per-execution XLA ``cost_analysis`` (flops, bytes accessed)
+        of this batcher's decode-path program — ``_step_chunk`` in
+        lockstep mode, ``_spec_verify`` in speculative mode — computed
+        ONCE, lazily, at the first roofline scrape. Lowering uses
+        ``ShapeDtypeStruct`` stand-ins (never touches live buffers —
+        a scrape can race a ticking thread's donation) and never
+        compiles, so the watched jit caches do not grow: pulling
+        roofline numbers must not itself read as a recompile
+        (sentinel-checked in tests). Failures (exotic backend, no
+        analysis support) cache as empty — a scrape degrades to no
+        roofline gauges, never to an error."""
+        if self._roofline_costs is not None:
+            return self._roofline_costs
+        av = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            (self.variables, self._caches, self._dstate),
+        )
+        a_vars, a_caches, a_dstate = av
+        a_table = (
+            jax.ShapeDtypeStruct(
+                (len(self.slots), self._pager.pages_per_slot), jnp.int32
+            )
+            if self._paged
+            else None
+        )
+        costs: dict[str, dict[str, float]] = {}
+        try:
+            if self._spec is not None:
+                a_dtoks = jax.ShapeDtypeStruct(
+                    (self._spec_k + 1, len(self.slots)), jnp.int32
+                )
+                costs["verify"] = program_cost_analysis(
+                    type(self)._spec_verify,
+                    self, a_vars, a_caches, a_dstate, a_dtoks, a_table,
+                )
+            else:
+                costs["decode"] = program_cost_analysis(
+                    type(self)._step_chunk,
+                    self, a_vars, a_caches, a_dstate, a_table,
+                    truncate=False, nucleus=False,
+                )
+        except Exception as e:  # noqa: BLE001 — degrade, don't break scrape
+            log.info("roofline cost analysis unavailable: %r", e)
+        self._roofline_costs = costs
+        return costs
+
+    def _roofline_stats(self) -> dict[str, dict[str, float]]:
+        """Pull-style roofline source (``utils.profiling``): static
+        flops/bytes per program execution joined with the live phase
+        wall times (``EngineObs.last_s`` — populated when
+        ``obs_engine`` is enabled; without it the gauges carry
+        flops/bytes but no utilization, same contract as an unknown
+        peak)."""
+        out: dict[str, dict[str, float]] = {}
+        last = self._eobs.last_s
+        for prog in self._program_costs():
+            st = dict(self._roofline_costs[prog])
+            # Program names deliberately equal their tick-phase names
+            # ("decode" / "verify") — the join is a dict lookup.
+            st["wall_s"] = last.get(prog)
+            out[prog] = st
         return out
 
     def logprobs(self, req_id: int) -> np.ndarray:
@@ -2270,6 +2545,7 @@ class ContinuousBatcher:
         instances' bytes summed (a phantom leak). Idempotent; call
         after :meth:`stop` when the batcher is permanently done."""
         unregister_memory_source("continuous", self)
+        unregister_roofline_source("continuous", self)
         _LIVE_BATCHERS.discard(self)
 
     def result(self, req_id: int, timeout: float = 300.0) -> np.ndarray:

@@ -17,11 +17,32 @@ warm-up distribution forever; this one shifts as traffic shifts
 ``comm.codec.copy_stats``) into :meth:`snapshot`: collectors run at
 scrape time, right before the snapshot is taken, so ``/metrics`` shows
 their current values without a push on every hot-path mutation.
+
+**Windowed snapshots** (``docs/OBSERVABILITY.md`` "Workload
+telemetry"): cumulative-since-boot percentiles are useless for "what
+was p99 TTFT during *this* load phase" — the warm-up phase's samples
+never leave the reservoir. ``snapshot(window=True)`` opens a WINDOW: a
+per-histogram decimating-reservoir FORK that receives every subsequent
+observation in parallel with the cumulative reservoir.
+``snapshot(since=prev)`` then closes ``prev``'s window and returns the
+window's view — counter DELTAS against ``prev`` and histogram
+summaries computed from the fork alone (percentile isolation: a
+window's p99 contains only the window's samples). Phase-by-phase
+chaining passes ``window=True`` with every read that has a next phase
+(``s = reg.snapshot(window=True); ...;
+s = reg.snapshot(since=s, window=True)``); the final read omits it,
+so a finished sweep leaves NO open window behind. Hot-path cost:
+zero when no window is open (one truthiness
+check under the already-held lock); one extra reservoir append per
+open window otherwise. Open windows are bounded (``_MAX_WINDOWS``,
+oldest evicted) so an abandoned window can never leak observations
+forever.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from collections import defaultdict
 from collections.abc import Callable, Iterable
 
@@ -91,12 +112,23 @@ class _Histogram:
 
 
 class MetricsRegistry:
+    #: Max concurrently open snapshot windows; opening past it evicts
+    #: the OLDEST window (its ``snapshot(since=...)`` read then falls
+    #: back to cumulative summaries, flagged ``window_evicted``) so an
+    #: abandoned window cannot make every observe() pay forever.
+    _MAX_WINDOWS = 8
+
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: dict[str, float] = defaultdict(float)
         self._gauges: dict[str, float] = {}
         self._histograms: dict[str, _Histogram] = defaultdict(_Histogram)
         self._collectors: list[Callable[["MetricsRegistry"], None]] = []
+        #: Open snapshot windows: id -> {histogram name -> fork}.
+        #: Forks are ordinary decimating reservoirs created lazily at
+        #: the first in-window observation of each histogram.
+        self._windows: dict[int, dict[str, _Histogram]] = {}
+        self._next_window = 0
 
     def inc(self, name: str, value: float = 1.0) -> None:
         with self._lock:
@@ -116,6 +148,12 @@ class MetricsRegistry:
     def observe(self, name: str, value: float) -> None:
         with self._lock:
             self._histograms[name].observe(value)
+            if self._windows:
+                for forks in self._windows.values():
+                    f = forks.get(name)
+                    if f is None:
+                        f = forks[name] = _Histogram()
+                    f.observe(value)
 
     def observe_many(self, name: str, values: Iterable[float]) -> None:
         """Batch observe under ONE lock acquisition — the serving paths
@@ -128,6 +166,13 @@ class MetricsRegistry:
             h = self._histograms[name]
             for v in values:
                 h.observe(v)
+            if self._windows:
+                for forks in self._windows.values():
+                    f = forks.get(name)
+                    if f is None:
+                        f = forks[name] = _Histogram()
+                    for v in values:
+                        f.observe(v)
 
     def counter(self, name: str) -> float:
         with self._lock:
@@ -143,7 +188,35 @@ class MetricsRegistry:
             if fn not in self._collectors:
                 self._collectors.append(fn)
 
-    def snapshot(self) -> dict:
+    def snapshot(
+        self, *, window: bool = False, since: dict | None = None
+    ) -> dict:
+        """Point-in-time view of every metric.
+
+        Plain ``snapshot()`` (the exporter's scrape) is unchanged:
+        cumulative counters, current gauges, whole-history histogram
+        summaries — and costs nothing on the observe() hot path.
+
+        ``window=True`` additionally OPENS a window: the returned dict
+        carries a ``"window"`` id and every later observation also
+        lands in that window's per-histogram reservoir forks.
+
+        ``since=prev`` (``prev`` a ``window=True`` snapshot) returns
+        the WINDOW view instead: ``counters`` are deltas vs ``prev``,
+        ``histograms`` summarize only the samples observed since
+        ``prev`` (fork reservoirs — percentile isolation between
+        phases), ``gauges`` stay current values (a gauge has no
+        meaningful delta), and ``window_s`` is the wall-clock span.
+        The read CLOSES ``prev``'s window; pass ``window=True``
+        alongside ``since=`` to open the next phase's window in the
+        same call (phase chaining) — a plain ``since=`` read opens
+        nothing, so one-shot callers cannot leak open windows that
+        every later observe() would pay for. Reading a window that was
+        evicted (``_MAX_WINDOWS`` exceeded) or never opened raises
+        ``ValueError`` for the latter and degrades to cumulative
+        summaries flagged ``"window_evicted": True`` for the former —
+        a load sweep must notice, not silently report boot-cumulative
+        percentiles as a phase's."""
         with self._lock:
             collectors = list(self._collectors)
         for fn in collectors:
@@ -151,21 +224,59 @@ class MetricsRegistry:
                 fn(self)
             except Exception:  # noqa: BLE001 — a scrape must not fail
                 pass
+        if since is not None and "window" not in since:
+            raise ValueError(
+                "snapshot(since=...) needs a snapshot taken with "
+                "window=True (or a previous since= snapshot)"
+            )
         with self._lock:
-            return {
-                "counters": dict(self._counters),
-                "gauges": dict(self._gauges),
-                "histograms": {
+            out: dict = {"gauges": dict(self._gauges)}
+            if since is None:
+                out["counters"] = dict(self._counters)
+                out["histograms"] = {
                     k: h.summary() for k, h in self._histograms.items()
-                },
-            }
+                }
+            else:
+                prev_counters = since.get("counters", {})
+                base = since.get("_abs_counters", prev_counters)
+                out["counters"] = {
+                    k: v - base.get(k, 0.0)
+                    for k, v in self._counters.items()
+                }
+                forks = self._windows.pop(since["window"], None)
+                if forks is None:
+                    out["histograms"] = {
+                        k: h.summary()
+                        for k, h in self._histograms.items()
+                    }
+                    out["window_evicted"] = True
+                else:
+                    out["histograms"] = {
+                        k: f.summary() for k, f in forks.items()
+                    }
+                out["window_s"] = time.monotonic() - since["_t"]
+            if window:
+                wid = self._next_window
+                self._next_window += 1
+                self._windows[wid] = {}
+                while len(self._windows) > self._MAX_WINDOWS:
+                    self._windows.pop(next(iter(self._windows)))
+                out["window"] = wid
+                out["_t"] = time.monotonic()
+                #: Absolute counter values at window open — the delta
+                #: base for the NEXT since= read (out["counters"] may
+                #: itself already be a delta).
+                out["_abs_counters"] = dict(self._counters)
+            return out
 
     def reset(self) -> None:
-        """Clear all recorded values (collectors stay registered)."""
+        """Clear all recorded values (collectors stay registered; open
+        windows are discarded)."""
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
             self._histograms.clear()
+            self._windows.clear()
 
 
 _GLOBAL = MetricsRegistry()

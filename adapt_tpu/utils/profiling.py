@@ -1,5 +1,5 @@
 """Engine-tier observability: compile sentinel, memory accounting,
-tick-phase timing.
+roofline (MBU/MFU) accounting, tick-phase timing.
 
 PR 2 made the *request* tier visible (timelines, stitched spans, flight
 recorder); this module watches the *engine* underneath — the things that
@@ -40,6 +40,25 @@ silently destroy TPU serving performance without ever failing a test:
   Sources are weakrefs: a retired batcher drops out of the gauges with
   its arrays, never pinned by telemetry.
 
+- **Roofline accounting** — how close the engine runs to the hardware
+  ceiling, from numbers the system already has: components register as
+  weakly-held roofline sources (:func:`register_roofline_source`)
+  exposing ``_roofline_stats() -> {program: {flops, bytes, wall_s}}``
+  — flops/bytes come from XLA's own ``cost_analysis()`` of the watched
+  executables (lowered once, lazily; no recompile, no jit-cache
+  growth), wall seconds from the :class:`EngineObs` phase timing the
+  tick loop already records. :func:`engine_collector` turns them into
+  ``engine.flops.<program>`` / ``engine.bytes_accessed.<program>``
+  gauges always, and — when the platform's peak numbers are known
+  (:func:`roofline_peaks`: TPU table mirroring
+  ``benchmarks/tpu_models.py``, or the ``ADAPT_TPU_PEAK_FLOPS`` /
+  ``ADAPT_TPU_PEAK_BYTES_S`` env overrides) — ``engine.mfu.<program>``
+  / ``engine.mbu.<program>`` plus headline ``engine.mfu`` /
+  ``engine.mbu`` taken from the byte-heaviest program (the one whose
+  stream defines the decode roofline). The CPU backend exports
+  bytes/flops WITHOUT utilization claims — there is no honest CPU
+  "peak" to divide by.
+
 - :class:`EngineObs` — the one-branch gate for per-phase tick timing
   (``config.ObservabilityConfig.obs_engine``). Enabled, each serving
   phase (admit / prefill / draft / verify / decode / commit / update in
@@ -55,6 +74,7 @@ Catalog + semantics: ``docs/OBSERVABILITY.md`` "Engine telemetry".
 from __future__ import annotations
 
 import math
+import os
 import threading
 import time
 import weakref
@@ -426,6 +446,10 @@ def engine_collector(reg: MetricsRegistry) -> None:
         for k, v in stats.items():
             totals[k] = totals.get(k, 0.0) + float(v)
     totals.update(_device_memory_stats())
+    # Roofline gauges ride the same write/stale-cleanup pass: a
+    # retired batcher's engine.flops.*/mbu/mfu entries disappear with
+    # its memory gauges instead of scraping stale forever.
+    totals.update(_roofline_gauges())
     for k, v in totals.items():
         reg.set_gauge(k, v)
     # Gauges whose every source retired since the last pass (a closed
@@ -446,6 +470,125 @@ def engine_collector(reg: MetricsRegistry) -> None:
 global_metrics().register_collector(engine_collector)
 
 
+# -- roofline accounting ----------------------------------------------------
+
+#: Peak (FLOP/s, HBM bytes/s) per JAX platform — the denominators of
+#: MFU/MBU. Values mirror the benchmark constants
+#: (``benchmarks/tpu_models.py`` TPU_V5E_PEAK_FLOPS = 197e12 bf16;
+#: ``benchmarks/README.md`` decode-MBU model uses 819 GB/s for v5e
+#: HBM). Platforms absent here (CPU!) get NO mfu/mbu gauges — flops
+#: and bytes export alone, because dividing by a made-up peak would
+#: manufacture a utilization number.
+ROOFLINE_PEAKS: dict[str, tuple[float, float]] = {
+    "tpu": (197e12, 8.19e11),
+}
+
+
+def roofline_peaks() -> tuple[float, float] | None:
+    """(peak FLOP/s, peak bytes/s) for the current backend, or None
+    when no honest peak is known. ``ADAPT_TPU_PEAK_FLOPS`` /
+    ``ADAPT_TPU_PEAK_BYTES_S`` env vars override both (set BOTH) — the
+    knob for other TPU generations, and what lets tests exercise the
+    mfu/mbu math on the CPU backend with explicit, visible peaks."""
+    env_f = os.environ.get("ADAPT_TPU_PEAK_FLOPS")
+    env_b = os.environ.get("ADAPT_TPU_PEAK_BYTES_S")
+    if env_f and env_b:
+        try:
+            return (float(env_f), float(env_b))
+        except ValueError:
+            return None
+    try:
+        import jax
+
+        platform = jax.local_devices()[0].platform
+    except Exception:  # noqa: BLE001 — no backend: no claims
+        return None
+    return ROOFLINE_PEAKS.get(platform)
+
+
+#: Weakly-held roofline sources: (label, id) -> object exposing
+#: ``_roofline_stats() -> {program: {"flops": F, "bytes": B,
+#: "wall_s": seconds-per-execution | None}}``. Same lifetime rules as
+#: the memory sources (a batcher's jit caches pin it — retire via
+#: :func:`unregister_roofline_source`, ``ContinuousBatcher.close``
+#: does).
+_ROOFLINE_SOURCES: "weakref.WeakValueDictionary[tuple[str, int], object]" = (
+    weakref.WeakValueDictionary()
+)
+
+
+def register_roofline_source(label: str, obj) -> None:
+    """Register ``obj`` (anything with ``_roofline_stats() -> dict``)
+    as a pull-style roofline source (weakref; several sources coexist,
+    later-registered same-program entries win)."""
+    if not hasattr(obj, "_roofline_stats"):
+        raise TypeError(f"{label}: source must expose _roofline_stats()")
+    with _MEMORY_LOCK:
+        _ROOFLINE_SOURCES[(label, id(obj))] = obj
+
+
+def unregister_roofline_source(label: str, obj) -> None:
+    """Drop ``obj`` from the roofline gauges (idempotent)."""
+    with _MEMORY_LOCK:
+        _ROOFLINE_SOURCES.pop((label, id(obj)), None)
+
+
+def program_cost_analysis(jit_fn, *args, **kwargs) -> dict[str, float]:
+    """``{"flops": F, "bytes": B}`` for ONE execution of ``jit_fn`` at
+    the given arguments, from XLA's own ``cost_analysis()`` on the
+    LOWERED module — no compile, no execution, and crucially no growth
+    of the jit's executable cache (sentinel-checked in tests: pulling
+    roofline numbers must never itself read as a recompile). Arguments
+    may be real arrays or ``jax.ShapeDtypeStruct``s — only shapes and
+    dtypes matter. Raises on backends whose lowering or analysis is
+    unavailable; callers cache and degrade."""
+    ca = jit_fn.lower(*args, **kwargs).cost_analysis()
+    if isinstance(ca, list):  # some backends return one dict per device
+        ca = ca[0] if ca else {}
+    return {
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes": float(ca.get("bytes accessed", 0.0)),
+    }
+
+
+def _roofline_gauges() -> dict[str, float]:
+    """Compute the roofline gauge family from the registered sources:
+    per-program flops/bytes always; per-program + headline MFU/MBU only
+    when the platform peak is known AND the program has a measured wall
+    time (``EngineObs`` phase timing — enable ``obs_engine`` to get
+    utilization numbers)."""
+    with _MEMORY_LOCK:
+        sources = list(_ROOFLINE_SOURCES.values())
+    out: dict[str, float] = {}
+    peaks = roofline_peaks()
+    best_bytes = -1.0
+    for obj in sources:
+        try:
+            stats = obj._roofline_stats()
+        except Exception:  # noqa: BLE001 — a sick source must not kill scrape
+            continue
+        for prog, st in stats.items():
+            flops = float(st.get("flops", 0.0))
+            nbytes = float(st.get("bytes", 0.0))
+            out[f"engine.flops.{prog}"] = flops
+            out[f"engine.bytes_accessed.{prog}"] = nbytes
+            wall = st.get("wall_s")
+            if peaks is None or not wall:
+                continue
+            peak_f, peak_b = peaks
+            mfu = flops / wall / peak_f
+            mbu = nbytes / wall / peak_b
+            out[f"engine.mfu.{prog}"] = mfu
+            out[f"engine.mbu.{prog}"] = mbu
+            if nbytes > best_bytes:
+                # Headline = the byte-heaviest program: its stream is
+                # what the decode roofline is made of.
+                best_bytes = nbytes
+                out["engine.mfu"] = mfu
+                out["engine.mbu"] = mbu
+    return out
+
+
 # -- tick-phase timing ------------------------------------------------------
 
 
@@ -461,10 +604,15 @@ class EngineObs:
     when a Dispatcher is constructed) or directly:
     ``global_engine_obs().enabled = True``."""
 
-    __slots__ = ("enabled",)
+    __slots__ = ("enabled", "last_s")
 
     def __init__(self):
         self.enabled = False
+        #: Most recent wall seconds per phase name — the per-execution
+        #: denominator the roofline gauges divide flops/bytes by (a
+        #: dict write per phase sample; no lock: single writer per
+        #: phase, readers tolerate one-sample staleness).
+        self.last_s: dict[str, float] = {}
 
     @staticmethod
     def now() -> float:
@@ -477,6 +625,7 @@ class EngineObs:
         (the next phase's open). ``span=False`` for sites that already
         record their own tracer span (``LocalPipeline``'s stage/hop)."""
         t1 = time.perf_counter()
+        self.last_s[name] = t1 - t0
         global_metrics().observe(f"engine.phase.{name}_s", t1 - t0)
         if span:
             tracer = global_tracer()

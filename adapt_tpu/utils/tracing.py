@@ -300,6 +300,10 @@ class FlightRecorder:
         self._capacity = capacity
         self.events_dropped = 0
         self.enabled = True
+        #: Lifetime count per event kind — survives ring eviction, so
+        #: lifecycle-edge accounting (every admit has a finish/cancel)
+        #: stays checkable after a storm overflows the ring.
+        self._kind_counts: collections.Counter = collections.Counter()
 
     def set_capacity(self, capacity: int) -> None:
         if capacity == self._capacity:
@@ -318,6 +322,7 @@ class FlightRecorder:
             if len(self._events) == self._capacity:
                 self.events_dropped += 1
             self._events.append(ev)
+            self._kind_counts[kind] += 1
 
     def events(self, kind: str | None = None) -> list[dict]:
         with self._lock:
@@ -325,12 +330,21 @@ class FlightRecorder:
                 e for e in self._events if kind is None or e["kind"] == kind
             ]
 
+    def kind_counts(self) -> dict[str, int]:
+        """Lifetime event count per kind, INDEPENDENT of ring eviction:
+        a cancel storm that overflows the ring still balances its books
+        here (admits == finishes when drained — the lifecycle-edge
+        invariant the storm tests pin)."""
+        with self._lock:
+            return dict(self._kind_counts)
+
     def snapshot(self) -> dict:
         """JSON-ready dump (the ``GET /debug/events`` body)."""
         with self._lock:
             return {
                 "capacity": self._capacity,
                 "dropped": self.events_dropped,
+                "kind_counts": dict(self._kind_counts),
                 "events": list(self._events),
             }
 
@@ -347,6 +361,7 @@ class FlightRecorder:
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self._kind_counts.clear()
 
 
 _GLOBAL = Tracer()

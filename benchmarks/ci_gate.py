@@ -24,7 +24,11 @@ Baseline format (``benchmarks/baselines/seed.json``)::
 
 ``suite`` names drivers under ``benchmarks/micro/`` (sans ``.py``) with
 their args, so the baseline and the workload that produced it travel
-together. Comparison is ONE-SIDED: a metric only fails when it is worse
+together; a ``/`` in the name resolves under ``benchmarks/`` instead
+(``"load/smoke"`` -> ``benchmarks/load/smoke.py``). A driver may emit
+SEVERAL records — one JSON object per stdout line — and each gates
+independently (the load smoke emits goodput AND attainment).
+Comparison is ONE-SIDED: a metric only fails when it is worse
 than ``value`` by more than ``abs_tol + |value| * rel_tol`` in its
 direction — improvements never fail the gate (re-baseline with
 ``--write-baseline`` when they should become the new floor). A driver
@@ -68,9 +72,12 @@ def run_suite(
     record under the driver's name (which compare() then fails)."""
     records: dict[str, dict] = {}
     for name, args in suite.items():
-        path = os.path.join(REPO, "benchmarks", "micro", name + ".py")
+        # "/" in the suite name addresses a driver package outside
+        # micro/ ("load/smoke" -> benchmarks/load/smoke.py).
+        parts = name.split("/") if "/" in name else ["micro", name]
+        path = os.path.join(REPO, "benchmarks", *parts) + ".py"
         cmd = [sys.executable, path, *[str(a) for a in args]]
-        rec = None
+        recs: list[dict] = []
         err = ""
         try:
             proc = subprocess.run(
@@ -81,21 +88,23 @@ def run_suite(
                 cwd=REPO,
                 env={**os.environ, "JAX_PLATFORMS": "cpu"},
             )
+            # Multi-record contract: every parseable '{'-line is one
+            # record (the load smoke gates two metrics from one run).
             for ln in proc.stdout.splitlines():
                 ln = ln.strip()
                 if ln.startswith("{"):
                     try:
-                        rec = json.loads(ln)
-                        break
+                        recs.append(json.loads(ln))
                     except json.JSONDecodeError:
                         continue  # stray '{'-noise; keep scanning
-            if rec is None:
+            if not recs:
                 err = (proc.stderr or proc.stdout or "").strip()[-300:]
         except subprocess.TimeoutExpired:
             err = f"driver timed out after {timeout_s:.0f}s"
-        if rec is None:
-            rec = {"metric": name, "value": 0.0, "error": err}
-        records[str(rec.get("metric", name))] = rec
+        if not recs:
+            recs = [{"metric": name, "value": 0.0, "error": err}]
+        for rec in recs:
+            records[str(rec.get("metric", name))] = rec
     return records
 
 

@@ -1,0 +1,24 @@
+"""SLO-aware closed-loop load harness for the continuous batcher.
+
+ROADMAP item 4's measurement half: before an adaptive scheduler can
+claim "goodput degrades gracefully under overload", something must
+generate realistic traffic, record whether each request met its latency
+budget, and put load, SLO attainment and hardware utilization on one
+timeline. This package is that instrument:
+
+- ``workload.py`` — seeded OPEN-LOOP arrival schedules (Poisson or
+  deterministic spacing), heavy-tailed prompt/output lengths, tenant
+  skew and cancel storms. A ``(WorkloadSpec, seed)`` pair fully
+  determines the schedule — two runs submit identical requests.
+- ``harness.py`` — drives a REAL ``ContinuousBatcher`` tick loop under
+  a schedule, reads per-phase SLO attainment + windowed TTFT/ITL
+  percentiles through the ``MetricsRegistry`` snapshot-delta API, and
+  sweeps arrival rates into a goodput-vs-offered-load curve (BENCH-style
+  report JSON, roofline-annotated).
+- ``smoke.py`` — the CI-sized run (tiny model, two arrival rates,
+  fixed seed) gated by ``benchmarks/ci_gate.py`` via
+  ``baselines/seed.json`` (``load_goodput_tokens_s``,
+  ``load_slo_attainment``).
+
+How-to: ``docs/OBSERVABILITY.md`` "Workload telemetry".
+"""

@@ -1,0 +1,346 @@
+"""Closed-loop load harness: real batcher ticks under open-loop traffic.
+
+``drive_phase`` submits one :mod:`benchmarks.load.workload` schedule
+against a live ``ContinuousBatcher`` on the wall clock (arrivals are
+open-loop: the server being slow never slows the offered load), drives
+the synchronous tick loop until the phase drains, and reads the phase's
+telemetry through the ``MetricsRegistry`` windowed snapshot-delta API —
+so TTFT/ITL percentiles, SLO attainment and goodput are THIS phase's,
+not cumulative-since-boot. ``run_sweep`` chains phases over an
+arrival-rate ladder on ONE batcher (jit caches are per-instance; a
+fresh batcher per point would re-pay every compile) and emits the
+goodput-vs-offered-load curve as a BENCH-style report, each point
+annotated with the roofline gauges (``engine.mbu``/``engine.mfu`` —
+how bandwidth-bound the engine actually was at that load).
+
+Determinism contract (pinned in ``tests/test_load.py``): the schedule
+is a pure function of ``(spec, seed)``, greedy streams are
+request-deterministic whatever the slot scheduling, and cancel marks
+live in TOKEN space — two runs submit identical requests and finish
+with identical per-request token counts. Wall-clock things (latencies,
+goodput) are measurements, not replayable values.
+
+Driver usage (one BENCH-style JSON line on stdout)::
+
+    python benchmarks/load/harness.py --rates 4,8,16,32 --seed 0
+    python benchmarks/load/harness.py --rates 8 --cancel-pct 50
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks.common import int_flag, str_flag  # noqa: E402
+from benchmarks.load.workload import (  # noqa: E402
+    Arrival,
+    WorkloadSpec,
+    build_schedule,
+    offered_tokens,
+    schedule_digest,
+)
+
+#: Per-phase wall guard: a wedged phase (stuck tick, runaway compile)
+#: must fail the run loudly, not hang CI.
+PHASE_WALL_GUARD_S = 300.0
+
+
+def warmup(bat, vocab: int, steps_max: int, prompt_max: int) -> None:
+    """Pre-pay the compile cost the first phase would otherwise eat as
+    fake TTFT: one admission per prompt bucket the workload can hit
+    (prefill variants), with step counts covering the key-block
+    power-of-two buckets (``_stage_slot`` variants), then drain."""
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    max_len = bat.lm.max_len
+    # One admission per reachable prompt bucket (prefill variants).
+    for bucket in bat.prompt_buckets:
+        n_steps = min(2, max_len - bucket)
+        if n_steps < 1:
+            break
+        bat.submit(
+            rng.randint(0, vocab, size=bucket).astype(np.int32), n_steps
+        )
+        if bucket >= prompt_max:
+            break  # later buckets are unreachable for this workload
+    # Every key-block power-of-two bucket a step count in
+    # [1, steps_max] can map to (nkb = pow2ceil(steps)), so no phase
+    # admission compiles a fresh _stage_slot variant mid-measurement.
+    s = 1
+    while True:
+        bat.submit(rng.randint(0, vocab, size=2).astype(np.int32),
+                   min(s, steps_max))
+        if s >= steps_max:
+            break
+        s *= 2
+    bat.run()
+
+
+def drive_phase(
+    bat,
+    schedule: list[Arrival],
+    spec: WorkloadSpec,
+    registry=None,
+    wall_guard_s: float = PHASE_WALL_GUARD_S,
+) -> dict:
+    """Run one phase to drain; returns the phase report (windowed
+    metrics + per-request token counts + digests)."""
+    import numpy as np
+
+    from adapt_tpu.config import SLOSpec
+    from adapt_tpu.utils.metrics import global_metrics
+    from adapt_tpu.utils.tracing import global_flight_recorder
+
+    reg = registry if registry is not None else global_metrics()
+    recorder = global_flight_recorder()
+    finishes0 = recorder.kind_counts().get("finish", 0)
+    n = len(schedule)
+    counts = [0] * n  # emitted tokens per scheduled request
+    cancelled = [False] * n
+
+    def make_cb(i: int, a: Arrival):
+        if a.cancel_after is None:
+            def cb(rid, tok, idx, _i=i):
+                counts[_i] += 1
+        else:
+            def cb(rid, tok, idx, _i=i, _c=a.cancel_after):
+                counts[_i] += 1
+                if counts[_i] == _c:
+                    # Token-space cancel mark: the marker is consumed
+                    # at the next commit boundary, so the final stream
+                    # length is deterministic (exactly _c tokens).
+                    cancelled[_i] = True
+                    bat.cancel(rid)
+        return cb
+
+    win = reg.snapshot(window=True)
+    t0 = time.perf_counter()
+    pi = 0
+    ticks0 = bat.stats()["ticks"]
+    while True:
+        now = time.perf_counter() - t0
+        while pi < n and schedule[pi].t <= now:
+            a = schedule[pi]
+            bat.submit(
+                np.asarray(a.prompt, np.int32),
+                a.steps,
+                slo=SLOSpec(
+                    ttft_budget_s=spec.ttft_budget_s,
+                    itl_budget_s=spec.itl_budget_s,
+                    tenant=a.tenant,
+                ),
+                on_token=make_cb(pi, a),
+            )
+            pi += 1
+        finished = recorder.kind_counts().get("finish", 0) - finishes0
+        if pi >= n and finished >= n:
+            break
+        if now > wall_guard_s:
+            raise RuntimeError(
+                f"phase wall guard ({wall_guard_s:.0f}s) exceeded: "
+                f"{finished}/{n} finished, {pi}/{n} submitted"
+            )
+        if pi < n and finished == pi:
+            # Fully drained but the next arrival is in the future:
+            # nap until it (bounded) instead of busy-spinning ticks.
+            gap = schedule[pi].t - (time.perf_counter() - t0)
+            if gap > 0:
+                time.sleep(min(gap, 0.01))
+        bat.tick()
+    wall_s = time.perf_counter() - t0
+
+    delta = reg.snapshot(since=win)
+    c = delta["counters"]
+    window_s = delta["window_s"]
+
+    def attainment(prefix: str) -> float | None:
+        met = c.get(f"slo.{prefix}_met_total", 0.0)
+        missed = c.get(f"slo.{prefix}_missed_total", 0.0)
+        return met / (met + missed) if met + missed else None
+
+    per_tenant: dict[str, dict[str, float]] = {}
+    for key, v in c.items():
+        for kind in ("met", "missed"):
+            pre = f"slo.{kind}_total."
+            if key.startswith(pre):
+                per_tenant.setdefault(
+                    key[len(pre):], {"met": 0.0, "missed": 0.0}
+                )[kind] = v
+    req_met = sum(t["met"] for t in per_tenant.values())
+    req_missed = sum(t["missed"] for t in per_tenant.values())
+
+    def pct(hname: str) -> dict:
+        h = delta["histograms"].get(hname, {})
+        return {
+            k: round(h[k], 6) for k in ("p50", "p99", "max") if k in h
+        }
+
+    roofline = {
+        k: v
+        for k, v in delta["gauges"].items()
+        if k.startswith(
+            ("engine.mbu", "engine.mfu", "engine.flops",
+             "engine.bytes_accessed")
+        )
+    }
+    return {
+        "requests": n,
+        "offered_rps": round(n / spec.duration_s, 4),
+        "offered_tokens_s": round(
+            offered_tokens(schedule) / spec.duration_s, 2
+        ),
+        "goodput_tokens_s": round(
+            c.get("continuous.good_tokens_total", 0.0) / window_s, 2
+        ),
+        "throughput_tokens_s": round(
+            c.get("continuous.tokens_total", 0.0) / window_s, 2
+        ),
+        "slo_attainment": (
+            round(req_met / (req_met + req_missed), 4)
+            if req_met + req_missed
+            else None
+        ),
+        "ttft_attainment": attainment("ttft"),
+        "itl_attainment": attainment("itl"),
+        "per_tenant": per_tenant,
+        "ttft_s": pct("continuous.ttft_s"),
+        "itl_s": pct("continuous.itl_s"),
+        "queue_wait_s": pct("continuous.queue_wait_s"),
+        "cancelled": int(sum(cancelled)),
+        "tokens_delivered": int(sum(counts)),
+        "token_counts": counts,
+        "ticks": bat.stats()["ticks"] - ticks0,
+        "wall_s": round(wall_s, 3),
+        "window_s": round(window_s, 3),
+        "roofline": roofline,
+        "schedule_digest": schedule_digest(schedule),
+    }
+
+
+def run_sweep(
+    bat,
+    spec: WorkloadSpec,
+    rates: list[float],
+    seed: int,
+    registry=None,
+) -> list[dict]:
+    """One phase per offered rate on ONE batcher (phase seeds derive
+    from ``seed`` + the rate index, so every point is independently
+    deterministic). Returns the curve points in sweep order."""
+    points = []
+    for i, rate in enumerate(rates):
+        pspec = dataclasses.replace(spec, rate_rps=float(rate))
+        schedule = build_schedule(pspec, seed + i)
+        report = drive_phase(bat, schedule, pspec, registry=registry)
+        report["rate_rps"] = float(rate)
+        report["seed"] = seed + i
+        points.append(report)
+    return points
+
+
+def build_batcher(
+    vocab: int,
+    max_len: int,
+    slots: int,
+    chunk: int,
+    layout: str = "slots",
+):
+    """The harness's model+batcher factory (CPU-forced; tiny LM — the
+    harness measures the serving tier's behavior under load, not model
+    quality)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+    from adapt_tpu.models.transformer_lm import lm_tiny
+    from adapt_tpu.runtime.continuous import ContinuousBatcher
+
+    lm = lm_tiny(vocab=vocab, max_len=max_len)
+    variables = lm.graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )
+    return ContinuousBatcher(
+        lm, variables, slots=slots, chunk=chunk, kv_layout=layout
+    )
+
+
+def main() -> int:
+    rates_arg = str_flag(sys.argv, "--rates", "4,8,16,32")
+    seed = int_flag(sys.argv, "--seed", 0)
+    slots = int_flag(sys.argv, "--slots", 4)
+    chunk = int_flag(sys.argv, "--chunk", 8)
+    duration = int_flag(sys.argv, "--duration", 3)
+    cancel_pct = int_flag(sys.argv, "--cancel-pct", 0)
+    layout = str_flag(
+        sys.argv, "--layout", "slots", choices=("slots", "paged")
+    )
+    out = str_flag(sys.argv, "--out", "")
+    try:
+        rates = [float(r) for r in rates_arg.split(",") if r]
+        spec = WorkloadSpec(
+            duration_s=float(duration),
+            cancel_fraction=cancel_pct / 100.0,
+        )
+        from adapt_tpu.utils.profiling import global_engine_obs
+
+        bat = build_batcher(
+            spec.vocab,
+            spec.prompt_max + spec.steps_max + 8,
+            slots,
+            chunk,
+            layout,
+        )
+        # Phase timing on: every curve point gets its roofline
+        # annotation (mbu/mfu need measured phase walls).
+        global_engine_obs().enabled = True
+        warmup(bat, spec.vocab, spec.steps_max, spec.prompt_max)
+        points = run_sweep(bat, spec, rates, seed)
+        peak = max(p["goodput_tokens_s"] for p in points)
+        report = {
+            "metric": "load_goodput_curve",
+            "value": peak,
+            "unit": "tokens/s (peak goodput over the sweep)",
+            "vs_baseline": 0.0,
+            "rates_rps": rates,
+            "seed": seed,
+            "slots": slots,
+            "chunk": chunk,
+            "layout": layout,
+            "spec": dataclasses.asdict(spec),
+            "points": [
+                {k: v for k, v in p.items() if k != "token_counts"}
+                for p in points
+            ],
+        }
+        print(json.dumps(report), flush=True)
+        if out:
+            os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+            with open(out, "w", encoding="utf-8") as f:
+                json.dump(report, f, indent=1)
+                f.write("\n")
+    except Exception as e:  # noqa: BLE001 — always one JSON line, rc 0
+        print(
+            json.dumps(
+                {
+                    "metric": "load_goodput_curve",
+                    "value": 0.0,
+                    "unit": "tokens/s (peak goodput over the sweep)",
+                    "vs_baseline": 0.0,
+                    "error": str(e)[-300:],
+                }
+            ),
+            flush=True,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

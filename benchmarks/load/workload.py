@@ -1,0 +1,181 @@
+"""Seeded workload generation: open-loop arrivals, heavy tails, skew.
+
+The schedule is built ENTIRELY up front from ``(WorkloadSpec, seed)`` —
+arrival times, prompts, output lengths, tenants, cancel marks — so two
+runs of the same spec offer bit-identical traffic (the harness's
+determinism contract) and the arrival process stays OPEN-LOOP: a slow
+server does not slow the offered load down, which is exactly what makes
+overload visible (closed-loop clients self-throttle and hide it).
+
+Length distributions are lognormal (the classic heavy-tailed fit for
+both prompt and output lengths in production traces): most requests are
+short, a deterministic-seeded minority are many times the median, which
+is what makes head-of-line and slot-occupancy effects show up at
+moderate mean load. Tenant choice is Zipf-weighted (rank ``r`` gets
+weight ``1/r^skew``) so one tenant dominates — the skew the per-tenant
+``slo.{met,missed}_total`` counters exist to expose. A ``cancel_mark``
+on a request means the DRIVER cancels it after that many emitted
+tokens; marking in token space (not wall time) keeps the resulting
+token counts deterministic across runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadSpec:
+    """One load phase's traffic recipe (all knobs seed-deterministic)."""
+
+    #: "poisson" (exponential inter-arrivals — bursty, the honest
+    #: default) or "deterministic" (fixed spacing — isolates queueing
+    #: from burstiness).
+    arrival: str = "poisson"
+    #: Offered arrival rate, requests/second (open-loop).
+    rate_rps: float = 8.0
+    #: Arrival-window length in seconds: requests arrive in [0, T);
+    #: the phase then drains.
+    duration_s: float = 4.0
+    #: Token-id universe for synthetic prompts.
+    vocab: int = 37
+    #: Prompt length: lognormal(median=prompt_median, sigma), clipped
+    #: to [1, prompt_max]. sigma is the heavy-tail knob (0 = constant).
+    prompt_median: int = 8
+    prompt_sigma: float = 0.6
+    prompt_max: int = 48
+    #: Output length (decode steps), same shape of distribution.
+    steps_median: int = 24
+    steps_sigma: float = 0.6
+    steps_max: int = 96
+    #: Tenant labels, Zipf-weighted by list rank (rank r ~ 1/r^skew).
+    tenants: tuple[str, ...] = ("t0", "t1", "t2", "t3")
+    tenant_skew: float = 1.5
+    #: Per-request latency budgets (None disables that budget).
+    ttft_budget_s: float | None = 1.0
+    itl_budget_s: float | None = 0.5
+    #: Cancel storm: this fraction of requests is marked for driver
+    #: cancellation after ``cancel_after_tokens`` emitted tokens.
+    cancel_fraction: float = 0.0
+    cancel_after_tokens: int = 4
+
+    def __post_init__(self):
+        if self.arrival not in ("poisson", "deterministic"):
+            raise ValueError(
+                f"arrival={self.arrival!r}: expected 'poisson' or "
+                "'deterministic'"
+            )
+        if self.rate_rps <= 0:
+            raise ValueError(f"rate_rps must be > 0, got {self.rate_rps}")
+        if self.duration_s <= 0:
+            raise ValueError(
+                f"duration_s must be > 0, got {self.duration_s}"
+            )
+        if not 0.0 <= self.cancel_fraction <= 1.0:
+            raise ValueError(
+                f"cancel_fraction must be in [0, 1], got "
+                f"{self.cancel_fraction}"
+            )
+        if not self.tenants:
+            raise ValueError("tenants must be non-empty")
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    """One scheduled request (everything the driver needs to submit)."""
+
+    t: float  # arrival offset from phase start, seconds
+    prompt: tuple[int, ...]
+    steps: int
+    tenant: str
+    #: Driver cancels after this many emitted tokens (None = run out).
+    cancel_after: int | None
+
+
+def _lognormal_len(
+    rng: np.random.RandomState, median: int, sigma: float, cap: int
+) -> int:
+    if sigma <= 0:
+        return min(median, cap)
+    v = int(round(rng.lognormal(mean=np.log(median), sigma=sigma)))
+    return int(np.clip(v, 1, cap))
+
+
+def build_schedule(spec: WorkloadSpec, seed: int) -> list[Arrival]:
+    """The whole phase's traffic, sorted by arrival time. Pure function
+    of ``(spec, seed)`` — the determinism contract the harness pins."""
+    rng = np.random.RandomState(seed)
+    times: list[float] = []
+    if spec.arrival == "poisson":
+        t = 0.0
+        while True:
+            t += float(rng.exponential(1.0 / spec.rate_rps))
+            if t >= spec.duration_s:
+                break
+            times.append(t)
+    else:
+        step = 1.0 / spec.rate_rps
+        times = list(np.arange(0.0, spec.duration_s, step))
+    weights = np.array(
+        [1.0 / (r + 1) ** spec.tenant_skew
+         for r in range(len(spec.tenants))]
+    )
+    weights /= weights.sum()
+    out: list[Arrival] = []
+    for t in times:
+        plen = _lognormal_len(
+            rng, spec.prompt_median, spec.prompt_sigma, spec.prompt_max
+        )
+        steps = _lognormal_len(
+            rng, spec.steps_median, spec.steps_sigma, spec.steps_max
+        )
+        prompt = tuple(
+            int(x) for x in rng.randint(0, spec.vocab, size=plen)
+        )
+        tenant = spec.tenants[
+            int(rng.choice(len(spec.tenants), p=weights))
+        ]
+        cancel_after = None
+        if spec.cancel_fraction and (
+            rng.uniform() < spec.cancel_fraction
+        ):
+            # Token-space mark (never wall clock): the cancel lands at
+            # a commit boundary after exactly this many tokens, so the
+            # cancelled stream's length is run-to-run deterministic.
+            cancel_after = max(
+                1, min(spec.cancel_after_tokens, steps - 1)
+            ) if steps > 1 else 1
+        out.append(
+            Arrival(
+                t=float(t),
+                prompt=prompt,
+                steps=steps,
+                tenant=tenant,
+                cancel_after=cancel_after,
+            )
+        )
+    return out
+
+
+def schedule_digest(schedule: list[Arrival]) -> str:
+    """Stable hash of every schedule field — the 'identical request
+    schedules' half of the determinism acceptance check."""
+    h = hashlib.sha256()
+    for a in schedule:
+        h.update(
+            repr(
+                (round(a.t, 9), a.prompt, a.steps, a.tenant,
+                 a.cancel_after)
+            ).encode()
+        )
+    return h.hexdigest()[:16]
+
+
+def offered_tokens(schedule: list[Arrival]) -> int:
+    """Total decode tokens the schedule asks for (cancel marks NOT
+    subtracted — offered load is what the clients wanted, goodput is
+    what the server delivered inside budget)."""
+    return sum(a.steps for a in schedule)

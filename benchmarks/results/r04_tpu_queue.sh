@@ -5,7 +5,7 @@
 # JSON line), so a relay re-outage mid-queue degrades to error rows,
 # not hangs. Usage: bash benchmarks/r04_tpu_queue.sh
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=benchmarks/results/r04
 mkdir -p "$OUT"
 log() { echo "=== $(date +%H:%M:%S) $*"; }

@@ -3,7 +3,7 @@
 # hangs ate (queue items 4b-6), plus a solo headline recapture.
 # Serial by design: NEVER two JAX processes through the relay at once.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=benchmarks/results/r04
 mkdir -p "$OUT"
 log() { echo "=== $(date +%H:%M:%S) $*"; }

@@ -7,7 +7,7 @@
 # 2) a 4k-context pair where cache traffic dominates weights ~3:1.
 # Serial by design: NEVER two JAX processes through the relay at once.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=benchmarks/results/r04
 mkdir -p "$OUT"
 log() { echo "=== $(date +%H:%M:%S) $*"; }

@@ -5,7 +5,7 @@
 # does (the serial rule). Gives up after MAX_TRIES probes.
 # Usage: bash benchmarks/r04_tpu_wait_and_run.sh benchmarks/r04_tpu_queue3.sh
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 QUEUE="${1:?queue script}"
 MAX_TRIES="${2:-25}"
 for i in $(seq 1 "$MAX_TRIES"); do

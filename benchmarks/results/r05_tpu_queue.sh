@@ -4,7 +4,7 @@
 # relay at once. Every child under its own timeout; artifacts append
 # (JSONL) beside older rows, never over them.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=benchmarks/results/r05
 mkdir -p "$OUT"
 log() { echo "=== $(date +%H:%M:%S) $*"; }

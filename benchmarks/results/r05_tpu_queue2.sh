@@ -3,7 +3,7 @@
 # (VERDICT r4 #4) + the remaining serving rows. Run AFTER r05_tpu_queue.sh.
 # Serial by design: NEVER two JAX processes through the relay at once.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=benchmarks/results/r05
 mkdir -p "$OUT"
 log() { echo "=== $(date +%H:%M:%S) $*"; }

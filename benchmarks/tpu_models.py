@@ -35,6 +35,11 @@ from benchmarks.common import (  # noqa: E402  (imports no JAX)
 )
 
 TPU_V5E_PEAK_FLOPS = 197e12  # bf16
+#: v5e HBM bandwidth — the MBU denominator (the same 819 GB/s the
+#: decode-MBU model in benchmarks/README.md uses). The serving tier's
+#: roofline gauges (`adapt_tpu.utils.profiling.ROOFLINE_PEAKS`) mirror
+#: this pair; keep them in sync.
+TPU_V5E_PEAK_HBM_BYTES_S = 8.19e11
 
 #: model -> (batch, fwd FLOPs/image (mul+add as 2, matching bench.py's
 #: ResNet convention of 8.2e9 = 2 x 4.1 GMACs), A100 img/s baseline);
