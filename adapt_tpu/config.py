@@ -179,6 +179,54 @@ class SpeculativeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class RecoveryConfig:
+    """Elastic mesh recovery for the tensor-parallel serving tier
+    (``runtime/continuous`` + ``control.registry.DeviceHealthMonitor``;
+    ``docs/SERVING.md`` "Elastic recovery").
+
+    When a device of the batcher's mesh is reported dead, the batcher
+    rebuilds its mesh from the surviving devices (tp shrinks to the
+    largest divisor of the old tp that still fits), re-validates the
+    model against the shrunk mesh, re-lowers its program families with
+    explicit shardings, and moves live request state across via an
+    explicit redistribution plan (``parallel.sharding.KVReshardPlan``)
+    — or replays requests from the journal/prefix cache when their
+    state cannot migrate. Fault model: COMPUTE loss — the lost shard's
+    KV heads are recovered through host staging (the simulated-kill
+    stand-in for the host-tier recovery source a real deployment
+    plugs in there); requests that opt out of migration replay from
+    the journal instead and still emit identical tokens."""
+
+    #: Recover inline at the next ``tick()`` after a loss. False: the
+    #: tick raises ``DeviceLostError`` and the operator (or serving
+    #: layer) calls :meth:`ContinuousBatcher.recover` explicitly.
+    auto_reshard: bool = True
+    #: Live-state policy for in-flight requests at recovery time:
+    #: ``"migrate"`` moves KV/sampling state to the shrunk mesh
+    #: (gather-free for surviving shards, host-staged for the lost
+    #: shard's heads) so requests continue bit-identically;
+    #: ``"replay"`` re-queues every in-flight request from the journal
+    #: (or the in-memory request record) — same final tokens, paid by
+    #: re-prefill (cheap again when the paged prefix cache still holds
+    #: the prompt pages). Requests mid-chunked-prefill always replay:
+    #: they have emitted nothing, so replay costs only the prefill
+    #: they had not finished.
+    policy: str = "migrate"
+    #: Refuse to shrink below this tp (raise ``DeviceLostError``
+    #: instead): capacity floor for deployments where a tp=1 remnant
+    #: could not hold the model.
+    min_tp: int = 1
+
+    def __post_init__(self):
+        if self.policy not in ("migrate", "replay"):
+            raise ValueError(
+                f"policy={self.policy!r}: expected 'migrate' or 'replay'"
+            )
+        if self.min_tp < 1:
+            raise ValueError(f"min_tp must be >= 1, got {self.min_tp}")
+
+
+@dataclasses.dataclass(frozen=True)
 class SLOSpec:
     """Per-request latency budget, evaluated by the serving tier's
     existing lifecycle stamps (``runtime/continuous`` request
@@ -285,4 +333,7 @@ class ServeConfig:
     )
     parallel: ParallelConfig = dataclasses.field(
         default_factory=ParallelConfig
+    )
+    recovery: RecoveryConfig = dataclasses.field(
+        default_factory=RecoveryConfig
     )

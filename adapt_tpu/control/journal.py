@@ -62,6 +62,11 @@ class DispatcherJournal:
         # writes, and what keeps compaction O(live state) not O(history).
         self._workers: dict[str, dict] = {}
         self._pending: set[int] = set()
+        #: Pending ids' submit metadata (sampling knobs etc. — whatever
+        #: JSON dict the submitter attached): what lets a replayed
+        #: request be RECONSTRUCTED from the journal, not just re-run
+        #: as a bare payload. Dropped with the done mark.
+        self._submit_meta: dict[int, dict] = {}
         #: ids whose payload write is in flight (reserved in
         #: record_submit BEFORE the file appears): the compaction sweep
         #: must not reap a payload whose submit mark hasn't landed yet.
@@ -93,9 +98,12 @@ class DispatcherJournal:
             self._workers.pop(rec["id"], None)
         elif op == "submit":
             self._pending.add(rec["id"])
+            if rec.get("meta") is not None:
+                self._submit_meta[rec["id"]] = rec["meta"]
             self._max_id = max(self._max_id, rec["id"])
         elif op == "done":
             self._pending.discard(rec["id"])
+            self._submit_meta.pop(rec["id"], None)
             self._max_id = max(self._max_id, rec["id"])
         elif op == "horizon":
             # Compaction's id-watermark record: keeps next_request_id
@@ -170,7 +178,11 @@ class DispatcherJournal:
                     + "\n"
                 )
             for rid in sorted(self._pending):
-                f.write(json.dumps({"op": "submit", "id": rid}) + "\n")
+                rec = {"op": "submit", "id": rid}
+                meta = self._submit_meta.get(rid)
+                if meta is not None:
+                    rec["meta"] = meta  # survives compaction with its mark
+                f.write(json.dumps(rec) + "\n")
             # Preserve the id horizon across compaction: recycled request
             # ids would break done-mark bookkeeping after recovery. A
             # dedicated record type — a "done" mark here would falsely
@@ -242,11 +254,17 @@ class DispatcherJournal:
     def _payload_path(self, request_id: int) -> str:
         return os.path.join(self.root, f"req_{request_id}.npy")
 
-    def record_submit(self, request_id: int, payload: Any) -> None:
+    def record_submit(
+        self, request_id: int, payload: Any, meta: dict | None = None
+    ) -> None:
         """Payload first (atomic rename), THEN the submit mark: the WAL
         never references bytes that aren't durably there. The id is
         reserved against the compaction sweep for the whole window where
-        the payload exists without its mark."""
+        the payload exists without its mark. ``meta`` (a JSON-able
+        dict — sampling knobs, step counts) rides on the submit mark so
+        a replayed request can be reconstructed from the journal alone
+        (:meth:`submit_meta` / :meth:`read_payload` — the elastic-
+        recovery replay path in ``runtime/continuous``)."""
         with self._lock:
             self._writing.add(request_id)
         try:
@@ -258,10 +276,25 @@ class DispatcherJournal:
                 os.fsync(f.fileno())
             os.replace(tmp, path)
             self._fsync_root()  # the rename must survive a host crash
-            self._append({"op": "submit", "id": request_id})
+            rec = {"op": "submit", "id": request_id}
+            if meta is not None:
+                rec["meta"] = meta
+            self._append(rec)
         finally:
             with self._lock:
                 self._writing.discard(request_id)
+
+    def submit_meta(self, request_id: int) -> dict | None:
+        """The ``meta`` dict journaled with a still-pending submit mark
+        (None once done-marked, or when none was attached)."""
+        with self._lock:
+            meta = self._submit_meta.get(request_id)
+            return dict(meta) if meta is not None else None
+
+    def read_payload(self, request_id: int) -> np.ndarray:
+        """Load one pending request's journaled payload (the replay
+        source — raises ``OSError`` if the payload is gone)."""
+        return np.load(self._payload_path(request_id), allow_pickle=False)
 
     #: Group-commit width for payload reclaim: one fsync per this many
     #: completions, then their payloads unlink in a batch.

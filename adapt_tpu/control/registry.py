@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections.abc import Callable
 
 from adapt_tpu.utils.logging import get_logger
@@ -160,3 +161,137 @@ class WorkerRegistry:
                 dead = [w for w, exp in self._leases.items() if exp <= now]
             if dead:
                 self._expire(dead, reason="lease expired")
+
+
+def weak_watch(watchable, obj, method_name: str) -> None:
+    """Subscribe ``obj.<method_name>(event, key)`` to
+    ``watchable.watch`` WEAKLY: watcher lists have no unwatch and
+    outlive subscribers, so a bound method there would pin a retired
+    subscriber (and everything it references — compiled state, KV
+    pools, Device handles) forever. The shim no-ops once ``obj`` is
+    collected or flips its ``_retired`` flag — the ONE definition of
+    the discipline every registry subscriber follows."""
+    wr = weakref.ref(obj)
+
+    def _cb(event: str, key: str, _wr=wr) -> None:
+        o = _wr()
+        if o is not None and not getattr(o, "_retired", False):
+            getattr(o, method_name)(event, key)
+
+    watchable.watch(_cb)
+
+
+class DeviceHealthMonitor:
+    """Device health over the SAME membership machinery the worker tier
+    uses: every tracked mesh device owns a :class:`WorkerRegistry`
+    lease under ``device:<id>``, and a loss is a ``leave`` event — the
+    etcd-membership-drives-repartitioning shape of the source paper,
+    applied to chips instead of hosts.
+
+    Simulated-kill injectable by construction: :meth:`kill` marks a
+    device dead and revokes its lease, firing every registry watcher
+    (the ``ContinuousBatcher`` subscribes and re-shards at its next
+    tick — or raises ``DeviceLostError`` from subsequent dispatches
+    when ``RecoveryConfig.auto_reshard`` is off). On real hardware the
+    same ``leave`` edge arrives from lease expiry when a chip's host
+    agent stops heartbeating; the serving tier cannot tell the
+    difference, which is the point — the recovery path tested against
+    :meth:`kill` is the one a real loss exercises.
+
+    Device leases default to a very long TTL (simulated devices have no
+    heartbeat loop; the event path is what this monitor models — the
+    TTL reaper stays the backstop for registries shared with real
+    workers)."""
+
+    #: Lease TTL for tracked devices (no heartbeat loop in-process —
+    #: effectively "until killed or deregistered").
+    DEVICE_TTL_S = 1e9
+
+    def __init__(self, registry: WorkerRegistry | None = None):
+        self.registry = registry if registry is not None else WorkerRegistry()
+        self._lock = threading.Lock()
+        self._dead: set[int] = set()
+        self._devices: dict[int, object] = {}  # device id -> jax Device
+        self._retired = False
+        # Fold ANY membership leave for a tracked device into the dead
+        # set — so a lease EXPIRY (the production loss signal, fired by
+        # the registry's TTL reaper) and kill() land identically, and
+        # recover()'s dead_ids() view always agrees with the leave
+        # event the batcher queued. Weak (see weak_watch): the watcher
+        # list outlives monitors.
+        weak_watch(self.registry, self, "_fold_leave")
+
+    def close(self) -> None:
+        """Retire the monitor: its fold watcher goes quiet (the shared
+        registry — and other monitors/batchers watching it — are
+        untouched)."""
+        self._retired = True
+
+    def _fold_leave(self, event: str, key: str) -> None:
+        if event != "leave" or not key.startswith("device:"):
+            return
+        try:
+            did = int(key.split(":", 1)[1])
+        except ValueError:
+            return
+        with self._lock:
+            if did in self._devices:
+                self._dead.add(did)
+
+    @staticmethod
+    def device_key(device) -> str:
+        """Membership key for a jax device — the ``/workers/<ip>``
+        analog."""
+        return f"device:{int(device.id)}"
+
+    def track(self, devices) -> None:
+        """Register every device of a mesh (idempotent — re-tracking a
+        device renews its lease, etcd keepalive semantics)."""
+        for d in devices:
+            with self._lock:
+                self._devices[int(d.id)] = d
+                fresh_dead = int(d.id) in self._dead
+            if fresh_dead:
+                continue  # a dead device does not rejoin by re-track
+            self.registry.register(
+                self.device_key(d),
+                meta={"platform": getattr(d, "platform", "unknown")},
+                ttl_s=self.DEVICE_TTL_S,
+            )
+
+    def kill(self, device) -> str:
+        """Simulate losing ``device`` (a jax Device or its integer id):
+        mark it dead and revoke its membership lease — registry
+        watchers fire ``('leave', 'device:<id>')`` synchronously on the
+        calling thread. Returns the membership key. Idempotent."""
+        did = int(device if isinstance(device, int) else device.id)
+        with self._lock:
+            already = did in self._dead
+            self._dead.add(did)
+        key = f"device:{did}"
+        if not already:
+            self.registry.deregister(key)
+        return key
+
+    def is_dead(self, device) -> bool:
+        did = int(device if isinstance(device, int) else device.id)
+        with self._lock:
+            return did in self._dead
+
+    def dead_ids(self) -> set[int]:
+        with self._lock:
+            return set(self._dead)
+
+    def alive_devices(self, devices) -> list:
+        """``devices`` filtered to the ones not marked dead (order
+        preserved — mesh rebuilds depend on it)."""
+        with self._lock:
+            dead = set(self._dead)
+        return [d for d in devices if int(d.id) not in dead]
+
+    def watch(self, callback: Callable[[str, str], None]) -> None:
+        """Subscribe to membership events (``callback(event, key)``,
+        event in {'join', 'leave'}) — delegates to the registry, so a
+        monitor sharing a registry with real workers delivers both
+        populations through one watch."""
+        self.registry.watch(callback)

@@ -12,10 +12,13 @@ encoder, ``lm_tp_rules`` for the transformer-LM serving tier), and
 
 from __future__ import annotations
 
+import dataclasses
 import re
 from collections.abc import Mapping
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -149,6 +152,193 @@ def merge_specs(a: P, b: P) -> P:
             )
         out.append(ax_a if ax_a is not None else ax_b)
     return P(*out)
+
+
+@dataclasses.dataclass
+class KVReshardPlan:
+    """Explicit redistribution plan for live head-sharded KV state
+    across a mesh SHRINK (elastic recovery: tp=4 -> tp=2 after a chip
+    loss) — the ``runtime/continuous`` migration executor.
+
+    The plan is per-SHARD, never a global gather (the
+    memory-efficient-redistribution discipline of arXiv:2112.01075):
+    each new shard's head range is an aligned union of old shard
+    ranges (``new_tp`` divides ``old_tp``, and both divide the head
+    count, so ranges tile exactly), and every old range moves by the
+    cheapest route its source allows —
+
+    - **surviving shard** -> device-to-device re-place onto the new
+      owner (counted in :attr:`moved_bytes`); the peer-to-peer
+      transfer shape of arXiv:2211.05322's cross-mesh resharding;
+    - **lost shard** -> staged through the HOST
+      (:attr:`host_staged_bytes`). Under the simulated-kill fault
+      model this reads the killed device's still-resident buffer; in a
+      real deployment this is the seam where the host-tier recovery
+      source (host-RAM KV mirror, disaggregated KV store, or
+      recompute-from-journal) plugs in — requests unwilling to pay it
+      replay from the journal instead
+      (``config.RecoveryConfig.policy``).
+
+    Replicated state (page tables, the device-resident sampling state,
+    the draft model) moves via :meth:`migrate_replicated`: one
+    surviving replica is the source, so a dead device never serves a
+    read on the fast path."""
+
+    #: Old tp-axis device order (mesh axis order — shard i held heads
+    #: ``[i * H/old_tp, (i+1) * H/old_tp)``).
+    old_devices: tuple
+    #: New tp-axis device order (the shrunk mesh's axis; a 1-tuple for
+    #: the single-device fallback).
+    new_devices: tuple
+    #: Device ids whose shards are lost (host-staged sources).
+    lost_ids: frozenset
+    #: Mesh axis the head splits live on (accounting/debug only — the
+    #: shard geometry is read off each migrated array's sharding).
+    axis: str = "tp"
+    #: Bytes moved device-to-device (surviving shards).
+    moved_bytes: int = 0
+    #: Bytes staged through the host (the lost shard's head ranges).
+    host_staged_bytes: int = 0
+
+    def __post_init__(self):
+        old_n, new_n = len(self.old_devices), len(self.new_devices)
+        if new_n < 1:
+            raise ValueError("plan needs at least one new device")
+        if old_n % new_n:
+            raise ValueError(
+                f"new tp {new_n} must divide old tp {old_n} — head "
+                "ranges only tile exactly for divisor shrinks"
+            )
+        survivors = {
+            int(d.id) for d in self.old_devices
+        } - set(self.lost_ids)
+        for d in self.new_devices:
+            if int(d.id) not in survivors:
+                raise ValueError(
+                    f"new device {d} is not a surviving old-mesh device"
+                )
+
+    def _shard_data(self, x) -> dict:
+        """device id -> resident single-device shard of ``x``."""
+        return {int(s.device.id): s.data for s in x.addressable_shards}
+
+    def migrate(self, x, new_sharding, head_dim: int = 1):
+        """Move ONE head-sharded leaf (heads on ``head_dim`` — the
+        repo-wide KV convention, dense strips / pools / int8 scale
+        planes alike) from its current layout onto ``new_sharding``.
+        Bit-exact: the output holds the same bytes re-placed, so a
+        migrated request's stream cannot diverge."""
+        shape = x.shape
+        old_map = x.sharding.devices_indices_map(shape)
+        old_data = self._shard_data(x)
+        # Old head ranges in ascending order: (lo, hi, device_id).
+        spans = sorted(
+            (
+                idx[head_dim].indices(shape[head_dim])[:2] + (int(d.id),)
+                for d, idx in old_map.items()
+            ),
+        )
+        new_map = new_sharding.devices_indices_map(shape)
+        bufs = []
+        for ndev, nidx in new_map.items():
+            lo, hi = nidx[head_dim].indices(shape[head_dim])[:2]
+            pieces, cover = [], lo
+            for slo, shi, did in spans:
+                if slo < lo or shi > hi:
+                    continue  # outside this new shard's range
+                if slo != cover:
+                    raise ValueError(
+                        f"head ranges misaligned: need [{lo},{hi}), "
+                        f"next source starts at {slo}, covered to {cover}"
+                    )
+                src = old_data[did]
+                if did in self.lost_ids:
+                    # Host staging: the ONLY read path touching the
+                    # lost shard (see class docstring for what stands
+                    # behind it on real hardware).
+                    src = np.asarray(src)
+                    self.host_staged_bytes += int(src.nbytes)
+                elif did != int(ndev.id):
+                    # A shard whose new owner is the device it already
+                    # lives on does not move (device_put is a no-op) —
+                    # moved_bytes reports real inter-device traffic,
+                    # the number ICI/capacity planning needs.
+                    self.moved_bytes += int(src.nbytes)
+                pieces.append(jax.device_put(src, ndev))
+                cover = shi
+            if cover != hi:
+                raise ValueError(
+                    f"head range [{lo},{hi}) not covered (reached "
+                    f"{cover}) — old/new shardings do not tile"
+                )
+            bufs.append(
+                pieces[0]
+                if len(pieces) == 1
+                else jnp.concatenate(pieces, axis=head_dim)
+            )
+        return jax.make_array_from_single_device_arrays(
+            shape, new_sharding, bufs
+        )
+
+    def migrate_tree(self, tree, new_sharding, head_dim: int = 1):
+        """:meth:`migrate` over every leaf of a KV pytree — the
+        ``(values, scales)`` members of quantized caches move under the
+        SAME plan, so a page's scales always travel with its int8
+        payload."""
+        return jax.tree.map(
+            lambda x: self.migrate(x, new_sharding, head_dim), tree
+        )
+
+    def migrate_replicated(self, tree, new_sharding):
+        """Re-place fully-replicated state (sampling state, draft
+        weights/caches, staged tables) onto the new layout, reading
+        from a SURVIVING replica — never the lost device."""
+
+        def one(x):
+            src = src_id = None
+            for s in x.addressable_shards:
+                if int(s.device.id) not in self.lost_ids:
+                    src, src_id = s.data, int(s.device.id)
+                    break
+            if src is None:  # every replica lost: host fallback
+                src = np.asarray(x)
+                self.host_staged_bytes += int(src.nbytes)
+            else:
+                # One copy per destination the replica does not already
+                # live on — a same-device re-place is a no-op
+                # device_put (migrate()'s only-real-traffic rule).
+                self.moved_bytes += int(src.nbytes) * sum(
+                    1
+                    for d in new_sharding.device_set
+                    if int(d.id) != src_id
+                )
+            return jax.device_put(src, new_sharding)
+
+        return jax.tree.map(one, tree)
+
+    def summary(self) -> dict:
+        """Accounting for flight events / logs."""
+        return {
+            "old_tp": len(self.old_devices),
+            "new_tp": len(self.new_devices),
+            "lost": sorted(self.lost_ids),
+            "moved_bytes": self.moved_bytes,
+            "host_staged_bytes": self.host_staged_bytes,
+        }
+
+
+def plan_kv_reshard(
+    old_devices, new_devices, lost_ids, axis: str = "tp"
+) -> KVReshardPlan:
+    """Build the :class:`KVReshardPlan` for a mesh shrink: ``old_devices``
+    in old tp-axis order, ``new_devices`` the surviving devices chosen
+    for the shrunk axis, ``lost_ids`` the dead device ids."""
+    return KVReshardPlan(
+        old_devices=tuple(old_devices),
+        new_devices=tuple(new_devices),
+        lost_ids=frozenset(int(i) for i in lost_ids),
+        axis=axis,
+    )
 
 
 def tree_shardings(

@@ -192,6 +192,32 @@ replicated draft scan is collective-free). ``stats()`` reports
 ``cache_bytes`` (logical) next to ``cache_bytes_per_device``; the
 ``memory.*_per_device`` gauges mirror it at scrape.
 
+**Elastic mesh recovery** (``health=`` +
+``control.registry.DeviceHealthMonitor``, knobs in
+``config.RecoveryConfig``; ``docs/SERVING.md`` "Elastic recovery"):
+losing one chip of the tp mesh no longer kills every in-flight
+request. The monitor feeds the TTL-lease membership machinery — a
+simulated kill (or a real lease expiry) arrives as a ``leave`` event,
+and the next tick re-shards: the mesh rebuilds from the surviving
+devices (tp=4 -> tp=2; largest divisor the survivors can host),
+weights re-place by the megatron rules, the program families re-lower
+with explicit shardings (sentinel warmups re-armed — ONE expected
+variant per family, no phantom alarms), and live KV/sampling state
+migrates via an explicit redistribution plan
+(``parallel.sharding.KVReshardPlan``: per-shard device-to-device
+moves for surviving shards, host staging only for the lost shard's
+heads), so migrated greedy requests finish **bit-identical** to an
+uninterrupted run. Requests that do not migrate (mid-chunked-prefill,
+or ``policy="replay"``) REPLAY from the journal (``journal=`` — a
+``control.journal.DispatcherJournal`` that records every submit's
+payload + sampling knobs and every finish's done mark), re-entering
+through the paged prefix cache when the prompt pages are still
+resident — identical tokens, paid by a suffix prefill instead of
+state migration. Lifecycle: ``device_lost`` / ``mesh_reshard`` /
+``kv_migrated`` / ``replayed_from_journal`` flight events,
+``recovery.wall_s`` histogram and ``recovery.{migrated,replayed,
+dropped}_total`` counters.
+
 Not in scope (v1): pipeline-parallel slots (compose with the pipelined
 decoders for models bigger than a TP group).
 """
@@ -211,9 +237,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import (
+    Mesh,
+    NamedSharding,
+    PartitionSpec as P,
+    SingleDeviceSharding,
+)
 
-from adapt_tpu.config import ParallelConfig, SLOSpec, SpeculativeConfig
+from adapt_tpu.config import (
+    ParallelConfig,
+    RecoveryConfig,
+    SLOSpec,
+    SpeculativeConfig,
+)
+from adapt_tpu.control.registry import weak_watch
 from adapt_tpu.models.speculative import accept_speculation, draft_chunk
 from adapt_tpu.models.transformer_lm import (
     TransformerLM,
@@ -221,10 +258,12 @@ from adapt_tpu.models.transformer_lm import (
     nucleus_filter,
     validate_tp,
 )
+from adapt_tpu.ops.decode_attention import check_head_parity
 from adapt_tpu.ops.quantize import dequantize_params, quantize_params
 from adapt_tpu.parallel.sharding import (
     kv_head_sharding,
     lm_tp_rules,
+    plan_kv_reshard,
     tree_shardings,
 )
 from adapt_tpu.runtime.paged import Pager, insert_prefill_pages
@@ -244,6 +283,15 @@ from adapt_tpu.utils.profiling import (
 from adapt_tpu.utils.tracing import global_flight_recorder, global_tracer
 
 log = get_logger("continuous")
+
+
+class DeviceLostError(RuntimeError):
+    """A device of the batcher's mesh was reported dead and automatic
+    resharding is off (``config.RecoveryConfig.auto_reshard=False``), or
+    recovery itself is impossible (every device lost, or the surviving
+    pool cannot support ``min_tp``). Call
+    :meth:`ContinuousBatcher.recover` — or re-raise to the serving
+    layer."""
 
 #: Live batchers (weak — telemetry must never pin a retired batcher's
 #: device arrays). The ONE "continuous.prefill" sentinel watch sums the
@@ -275,6 +323,31 @@ class _Request:
     stop: tuple[tuple[int, ...], ...] = ()
     #: Optional streaming callback (req_id, token, index) per commit.
     on_token: Callable[[int, int, int], None] | None = None
+    #: Tokens already DELIVERED before an elastic-recovery replay
+    #: re-queued this request: the re-run regenerates indices
+    #: 0..skip-1 identically (greedy, or the journaled key schedule),
+    #: so ``on_token`` suppresses them — the client's transcript stays
+    #: exactly-once — and the TTFT stamp (already taken at the original
+    #: first token) is not re-observed.
+    stream_skip: int = 0
+    #: Snapshot of the tokens (and logprobs) already delivered when an
+    #: elastic-recovery replay re-queued this request: a cancel landing
+    #: before the re-run catches up (queued, or live mid-regeneration)
+    #: resolves result() with these — result() must never contradict
+    #: the stream the client already received.
+    delivered_tokens: np.ndarray | None = None
+    delivered_lps: np.ndarray | None = None
+    #: Perf-clock stamp of the last token the client RECEIVED before a
+    #: replay re-queued this request: the first post-regeneration
+    #: token's ITL gap measures from here, so the kill-to-recovery
+    #: stall the client actually experienced is judged against the
+    #: budget exactly like a migrated request's is.
+    t_last_delivered: float = 0.0
+    #: Perf-clock stamp of the recovery re-queue (0.0 = first life):
+    #: the re-admission's queue-wait sample measures from here — from
+    #: t_submit it would span the whole first life plus the recovery,
+    #: which is not a queue wait.
+    t_requeued: float = 0.0
     #: Lifecycle anchor (perf-counter clock, stamped by submit):
     #: queue-wait, TTFT and request latency all measure from here.
     t_submit: float = 0.0
@@ -282,6 +355,11 @@ class _Request:
     #: first emitted token, ITL per commit; evaluation rides the
     #: obs_timeline gate.
     slo: SLOSpec | None = None
+    #: Set at the request's FIRST budget violation and carried across
+    #: recovery replays: the client experienced the miss, so a second
+    #: life must not re-enter goodput, re-fire ``slo_missed``, or
+    #: finish with a ``met`` tenant verdict.
+    slo_violated: bool = False
 
 
 @dataclasses.dataclass
@@ -349,6 +427,9 @@ class ContinuousBatcher:
         speculative: SpeculativeConfig | None = None,
         mesh: Mesh | None = None,
         parallel: ParallelConfig | None = None,
+        recovery: RecoveryConfig | None = None,
+        health=None,
+        journal=None,
     ):
         self.lm = lm
         # -- tensor parallelism (mesh-native serving) ----------------------
@@ -365,8 +446,9 @@ class ContinuousBatcher:
                 f"ParallelConfig(tp={parallel.tp}) requires a mesh"
             )
         self._mesh = mesh
+        self._axis = (parallel or ParallelConfig()).axis
         if mesh is not None:
-            axis = (parallel or ParallelConfig()).axis
+            axis = self._axis
             if axis not in mesh.shape:
                 raise ValueError(
                     f"mesh has no {axis!r} axis (axes: "
@@ -392,11 +474,18 @@ class ContinuousBatcher:
                 # compile-count parity with the no-mesh batcher (the
                 # tp=1 column of benchmarks/micro/tp_decode.py is this
                 # path). The local too: every placement site below
-                # branches on it.
+                # branches on it. The ONE thing kept from the mesh is
+                # its device: everything commits there via
+                # SingleDeviceSharding (the tp=1 REMNANT discipline
+                # recover() installs), so ``health=`` can track it —
+                # a loss raises DeviceLostError instead of silently
+                # dispatching onto the dead chip forever.
+                dev0 = list(mesh.devices.flat)[0]
                 mesh = None
                 self._mesh = None
-                self._repl = None
+                self._repl = SingleDeviceSharding(dev0)
                 self._kv_sharding = None
+                variables = jax.device_put(variables, self._repl)
             else:
                 #: Replicated placement for everything the host stages
                 #: (prompt ids, fused admission vectors, page tables,
@@ -790,6 +879,96 @@ class ContinuousBatcher:
         #: Exception that killed the server thread's tick (re-raised to
         #: result() waiters instead of a misleading timeout).
         self._server_error: BaseException | None = None
+        # -- elastic mesh recovery (docs/SERVING.md "Elastic recovery") ----
+        #: Knobs: auto-reshard at tick vs raise DeviceLostError,
+        #: migrate-vs-replay policy, min surviving tp.
+        self._recovery = recovery or RecoveryConfig()
+        #: ``control.registry.DeviceHealthMonitor`` (duck-typed): the
+        #: batcher registers its mesh devices as TTL-lease members and
+        #: subscribes to ``leave`` events — a simulated kill (or a real
+        #: lease expiry) lands in ``_lost_pending`` and the next tick
+        #: re-shards (or raises, per ``auto_reshard``).
+        self._health = health
+        #: Optional ``control.journal.DispatcherJournal``: submits are
+        #: journaled (payload + sampling-knob meta), finishes done-
+        #: marked, and non-migratable requests at recovery REPLAY from
+        #: the journaled record — re-entering through the paged prefix
+        #: cache when the prompt pages are still resident.
+        self._journal = journal
+        if journal is not None:
+            # Serving over an existing WAL (crash recovery) must not
+            # recycle ids: a fresh counter reaching a still-pending id
+            # would os.replace that request's journaled payload and
+            # done-mark it away — the exact hazard
+            # journal.next_request_id exists to prevent.
+            self._next_id = max(self._next_id, journal.next_request_id)
+        #: Membership keys of devices reported lost but not yet
+        #: recovered from (guarded by ``_cv``; consumed at tick entry).
+        self._lost_pending: list[str] = []
+        #: The devices serving this batcher, in tp-axis order — kept
+        #: distinct from ``_mesh`` because a tp=1 batcher (constructed
+        #: with a 1-device mesh, or the remnant a recovery down to tp=1
+        #: leaves) sets ``_mesh = None`` (single-device discipline)
+        #: while its device must STILL be trackable and
+        #: recoverable-from: losing it has to raise, not silently
+        #: dispatch onto a dead chip.
+        if self._mesh is not None:
+            self._mesh_devices: list = list(self._mesh.devices.flat)
+        elif isinstance(self._repl, SingleDeviceSharding):
+            self._mesh_devices = list(self._repl.device_set)
+        else:
+            self._mesh_devices = []
+        self._mesh_device_ids: set[int] = {
+            int(d.id) for d in self._mesh_devices
+        }
+        #: Static re-trace key for the programs that bake concrete
+        #: sharding constraints into their jaxprs (``_shard_kv`` /
+        #: ``_repl_state``): jit caches TRACES on avals + statics only,
+        #: so without this a post-recovery dispatch would reuse a jaxpr
+        #: whose constraints still name the dead device. Bumped once
+        #: per recovery.
+        self._mesh_epoch = 0
+        #: Per program family, the static-variant keys THIS batcher has
+        #: dispatched under the current mesh epoch (step_chunk's
+        #: (truncate, nucleus) combos, stage_slot's key buckets,
+        #: _insert's prompt buckets). ``recover()`` sizes each family's
+        #: expected-compile allowance from these — every variant in use
+        #: re-traces after the epoch bump, so a mixed-traffic batcher
+        #: legitimately re-lowers MORE than one variant per family.
+        #: Ticking-thread only (dispatch sites), like the caches.
+        self._variants: dict[str, set] = {}
+        #: Cumulative expected-compile allowances THIS batcher granted
+        #: at its recoveries (program -> units) — close() disarms them
+        #: so unconsumed slack cannot outlive the granter on the shared
+        #: class-level sentinel watches.
+        self._granted: dict[str, int] = {}
+        # Instance-lifetime recovery books (stats() mirrors of the
+        # recovery.* registry counters).
+        self._recoveries = 0
+        self._recovery_migrated = 0
+        self._recovery_replayed = 0
+        self._recovery_dropped = 0
+        self._last_recovery_wall_s = 0.0
+        #: close() flips this: a retired batcher must stop consuming
+        #: membership events (its compiled state is gone).
+        self._retired = False
+        if health is not None and self._mesh_devices:
+            health.track(self._mesh_devices)
+            # Weak subscription (control.registry.weak_watch): the
+            # watcher list has no unwatch and outlives any batcher — a
+            # bound method there would pin a retired batcher's weights
+            # and KV pools forever (the same discipline as
+            # _LIVE_BATCHERS being a WeakSet). The shim dies into a
+            # no-op when the batcher is collected, and goes quiet at
+            # close() via _retired.
+            weak_watch(health, self, "_on_device_event")
+            # A device already dead at construction — or killed between
+            # track() and watch() — delivers NO future 'leave' event
+            # (its lease is gone and track() refuses to resurrect it),
+            # so seed the pending set from the monitor's dead roster or
+            # every tick dispatches onto the dead chip undetected.
+            for did in sorted(health.dead_ids() & self._mesh_device_ids):
+                self._on_device_event("leave", f"device:{did}")
 
     # -- compiled pieces ---------------------------------------------------
 
@@ -800,9 +979,12 @@ class ContinuousBatcher:
         instead of trusting docstrings. Under a mesh, staged arrays are
         placed REPLICATED explicitly (a one-device-committed array mixed
         into a sharded program would force GSPMD reshards); one logical
-        transfer either way."""
+        transfer either way. ``_repl`` (not ``_mesh``) is the guard: a
+        batcher recovered down to tp=1 keeps staging onto its surviving
+        device (``SingleDeviceSharding``) — ``jnp.asarray`` would land
+        on the default device, which may be the dead one."""
         self._h2d_count += 1
-        if self._mesh is not None:
+        if self._repl is not None:
             return jax.device_put(x, self._repl)
         return jnp.asarray(x)
 
@@ -811,7 +993,15 @@ class ContinuousBatcher:
         pin every KV leaf (dense strips, pools, int8 scale planes) to
         the head-axis sharding so GSPMD partitions the decode math and
         inserts the block psums, instead of falling back to whatever
-        propagation guesses. No-mesh batchers pay one branch."""
+        propagation guesses. No-mesh batchers pay one branch.
+
+        The CONCRETE sharding is baked into the traced jaxpr, and jit
+        caches traces on avals + STATIC args only — which is why every
+        program that calls this (or ``_repl_state``) carries a static
+        ``epoch`` argument: elastic recovery bumps ``_mesh_epoch`` so
+        the re-lowered families re-TRACE against the shrunk mesh
+        instead of reusing a jaxpr whose constraints name dead
+        devices."""
         if self._mesh is None:
             return caches
         return jax.tree.map(
@@ -836,8 +1026,13 @@ class ContinuousBatcher:
             for k, x in dstate.items()
         }
 
-    @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
-    def _stage_slot(self, dstate, ints, floats, keys):
+    @partial(
+        jax.jit,
+        static_argnums=(0,),
+        static_argnames=("epoch",),
+        donate_argnums=(1,),
+    )
+    def _stage_slot(self, dstate, ints, floats, keys, *, epoch=0):
         """Write one admitted request's whole sampling row into the
         donated device state: ``ints`` (6,) int32 = [slot, tok, pos,
         top_k, nkeys, kbase], ``floats`` (2,) f32 = [temp, top_p],
@@ -860,8 +1055,13 @@ class ContinuousBatcher:
         d["active"] = dstate["active"].at[i].set(True)
         return self._repl_state(d)
 
-    @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
-    def _clear_slot(self, dstate, slot):
+    @partial(
+        jax.jit,
+        static_argnums=(0,),
+        static_argnames=("epoch",),
+        donate_argnums=(1,),
+    )
+    def _clear_slot(self, dstate, slot, *, epoch=0):
         """Retire one slot's device row: park its position at the idle
         sentinel and drop it from the active mask (the step re-parks it
         every chunk thereafter). Identity sampling knobs keep the
@@ -895,11 +1095,11 @@ class ContinuousBatcher:
     @partial(
         jax.jit,
         static_argnums=(0,),
-        static_argnames=("truncate", "nucleus"),
+        static_argnames=("truncate", "nucleus", "epoch"),
         donate_argnums=(2, 3),
     )
     def _step_chunk(self, variables, caches, dstate, table=None, *,
-                    truncate, nucleus):
+                    truncate, nucleus, epoch=0):
         """``chunk`` lockstep decode steps as one compiled scan over the
         DEVICE-RESIDENT slot state.
 
@@ -1002,8 +1202,14 @@ class ContinuousBatcher:
             self._repl_state(new),
         )
 
-    @partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
-    def _spec_verify(self, variables, caches, dstate, dtoks, table=None):
+    @partial(
+        jax.jit,
+        static_argnums=(0,),
+        static_argnames=("epoch",),
+        donate_argnums=(2, 3),
+    )
+    def _spec_verify(self, variables, caches, dstate, dtoks, table=None,
+                     *, epoch=0):
         """The speculative tick's VERIFY program — the second of its
         exactly two compiled programs (the first is the shared
         ``models/speculative.draft_chunk`` scan).
@@ -1243,6 +1449,11 @@ class ContinuousBatcher:
         kvs = self._draft_prefill_fn(bucket)(
             self._draft_variables, self._h2d(ids)
         )
+        # Draft K/V shapes differ from the target's, so a draft bucket
+        # is its own _insert variant even at the same prompt length.
+        self._variants.setdefault("continuous.insert", set()).add(
+            ("draft", bucket)
+        )
         self._draft_caches = self._insert(
             self._draft_caches, self._h2d(np.int32(slot_idx)), kvs
         )
@@ -1408,6 +1619,30 @@ class ContinuousBatcher:
             t_submit=time.perf_counter(),
             slo=slo,
         )
+        if self._journal is not None:
+            # Payload + knobs BEFORE the request becomes reachable: a
+            # replay (elastic recovery) or a crash-recovering process
+            # reconstructs the request from this record alone. The key
+            # schedule is journaled too, so sampled replays re-emit the
+            # identical stream.
+            try:
+                self._journal.record_submit(
+                    req_id,
+                    prompt,
+                    meta={
+                        "steps": steps,
+                        "temperature": req.temperature,
+                        "top_k": req.top_k,
+                        "top_p": req.top_p,
+                        "eos_id": eos_id,
+                        "stop": [list(s) for s in req.stop],
+                        "folded_keys": req.folded_keys.tolist(),
+                    },
+                )
+            except Exception as e:  # noqa: BLE001 — serve anyway, loudly
+                log.warning(
+                    "journal submit failed for %d: %r", req_id, e
+                )
         with self._cv:
             self._queue.append(req)
             self._cv.notify_all()  # wake the server thread, if any
@@ -1434,29 +1669,523 @@ class ContinuousBatcher:
                     # while it was mid-admission before being re-queued
                     # on pool pressure) must not outlive it.
                     self._cancelled.discard(req_id)
-                    self._done[req_id] = np.zeros((0,), np.int32)
-                    self._done_lps[req_id] = np.zeros((0,), np.float32)
+                    # A freshly queued request delivered nothing, but a
+                    # recovery-replayed one waiting for re-admission
+                    # already streamed its first life's tokens: result()
+                    # returns that snapshot, matching what a live cancel
+                    # after re-admission would return.
+                    if req.delivered_tokens is not None:
+                        self._done[req_id] = req.delivered_tokens
+                        self._done_lps[req_id] = req.delivered_lps
+                    else:
+                        self._done[req_id] = np.zeros((0,), np.int32)
+                        self._done_lps[req_id] = np.zeros((0,), np.float32)
                     self._cv.notify_all()
                     global_flight_recorder().record(
                         "cancel", request=req_id, state="queued"
                     )
-                    return True
-            # Live = bound to a slot, or mid-admission on the ticking
-            # thread (popped, not yet slot-bound). Anything else with a
-            # valid id already finished and was claimed.
-            live = req_id == self._admitting or any(
-                s.req is not None and s.req.req_id == req_id
-                for s in self.slots
+                    break
+            else:
+                # Live = bound to a slot, or mid-admission on the
+                # ticking thread (popped, not yet slot-bound). Anything
+                # else with a valid id already finished and was claimed.
+                live = req_id == self._admitting or any(
+                    s.req is not None and s.req.req_id == req_id
+                    for s in self.slots
+                )
+                if not live:
+                    return False
+                # Mark it; the ticking thread consumes the marker at
+                # its next boundary.
+                self._cancelled.add(req_id)
+                global_flight_recorder().record(
+                    "cancel", request=req_id, state="live"
+                )
+                return True
+        # Queued cancel: the done mark's disk write (periodic fsync,
+        # possible WAL compaction) must not run under the handoff lock
+        # — _finish and _drop_slot keep the same discipline.
+        self._journal_done(req_id)
+        return True
+
+    # -- elastic mesh recovery ---------------------------------------------
+
+    def _on_device_event(self, event: str, key: str) -> None:
+        """Membership watch callback (fires on the killer's / reaper's
+        thread): a ``leave`` for a device of OUR current mesh is queued
+        for the ticking thread to consume — detection is event-driven,
+        recovery runs only where the compiled state is owned."""
+        if event != "leave" or not key.startswith("device:"):
+            return
+        try:
+            did = int(key.split(":", 1)[1])
+        except ValueError:
+            return
+        with self._cv:
+            if did not in self._mesh_device_ids or key in self._lost_pending:
+                return
+            self._lost_pending.append(key)
+            self._cv.notify_all()  # wake an idle server thread
+        global_flight_recorder().record(
+            "device_lost", device=key, tp=self._tp
+        )
+        log.warning("mesh device lost: %s (tp=%d)", key, self._tp)
+
+    def device_lost_pending(self) -> bool:
+        """True when a mesh device loss awaits recovery (the next tick
+        re-shards, or raises under ``auto_reshard=False``)."""
+        with self._cv:
+            return bool(self._lost_pending)
+
+    def recover(self) -> dict:
+        """Re-shard the batcher onto its surviving devices after a
+        device loss — the elastic recovery path, end to end:
+
+        1. **shrink the mesh** — new tp is the largest divisor of the
+           old tp the survivors can host (divisors keep every
+           head-range split aligned, so the model re-validates by
+           construction — ``validate_tp`` + per-block
+           ``check_head_parity`` run anyway, by name);
+        2. **re-place weights** by the megatron rules on the shrunk
+           mesh (the checkpoint tier owns weight durability — under
+           the simulated kill the still-resident shards re-place
+           directly; a real deployment re-streams from checkpoint);
+        3. **migrate live state** via an explicit
+           ``parallel.sharding.KVReshardPlan``: head-sharded KV
+           (dense strips, paged (values, scales) pools) moves
+           per-shard — device-to-device where the shard survives,
+           host-staged for the lost shard's heads — and replicated
+           state (sampling ``_dstate``, draft weights/caches) re-places
+           from a surviving replica. Migrated requests continue
+           **bit-identically**;
+        4. **replay** requests whose state does not migrate
+           (``policy="replay"``, or mid-chunked-prefill slots) from the
+           journal — re-entering through the paged prefix cache when
+           the prompt pages are still resident — to identical tokens;
+        5. **re-arm** the compile sentinel for every program family:
+           the re-lowered variants (new shardings) are expected
+           compiles, not phantom-variant alarms.
+
+        Runs on the ticking thread (``tick`` calls it under
+        ``auto_reshard``); call it directly only with the batcher
+        stopped or between synchronous ticks. Returns the recovery
+        summary (also recorded as the ``mesh_reshard`` flight event).
+        Raises :class:`DeviceLostError` when no recovery exists (all
+        devices lost, or survivors below ``min_tp``)."""
+        t0 = time.perf_counter()
+        # NOTE: _lost_pending is cleared only on success (or when there
+        # is genuinely nothing to recover from) — a recovery that
+        # RAISES (min_tp floor, all devices lost) must leave the loss
+        # pending so every subsequent dispatch keeps raising instead of
+        # running on the broken layout.
+        old_devices = self._mesh_devices
+        if not old_devices:
+            # Never mesh-native: the monitor never targeted this
+            # batcher, so there is nothing to recover from. (A tp=1
+            # REMNANT keeps its one-entry device list — losing that
+            # device too must fall through to the every-device-lost
+            # raise below, not report healthy here.)
+            with self._cv:
+                self._lost_pending.clear()
+            return {"old_tp": self._tp, "new_tp": self._tp, "lost": []}
+        dead = (
+            self._health.dead_ids() if self._health is not None else set()
+        )
+        lost_here = sorted(
+            int(d.id) for d in old_devices if int(d.id) in dead
+        )
+        if not lost_here:
+            with self._cv:
+                self._lost_pending.clear()
+            return {"old_tp": self._tp, "new_tp": self._tp, "lost": []}
+        survivors = [d for d in old_devices if int(d.id) not in dead]
+        if not survivors:
+            raise DeviceLostError(
+                f"every device of the tp={self._tp} mesh is lost"
             )
-            if not live:
-                return False
-            # Mark it; the ticking thread consumes the marker at its
-            # next boundary.
-            self._cancelled.add(req_id)
-            global_flight_recorder().record(
-                "cancel", request=req_id, state="live"
+        old_tp = self._tp
+        new_tp = old_tp
+        while new_tp > len(survivors) or old_tp % new_tp:
+            new_tp -= 1
+        if new_tp < self._recovery.min_tp:
+            raise DeviceLostError(
+                f"{len(survivors)} survivors support tp={new_tp}, below "
+                f"RecoveryConfig.min_tp={self._recovery.min_tp}"
             )
-            return True
+        validate_tp(self.lm, new_tp)
+        axis = self._axis
+        new_devices = survivors[:new_tp]
+        plan = plan_kv_reshard(old_devices, new_devices, lost_here, axis)
+        if new_tp > 1:
+            new_mesh = Mesh(np.asarray(new_devices), (axis,))
+            repl = NamedSharding(new_mesh, P())
+            kv_sh = kv_head_sharding(new_mesh, axis)
+            self.variables = jax.device_put(
+                self.variables,
+                tree_shardings(
+                    self.variables, new_mesh,
+                    rules=partial(lm_tp_rules, axis=axis),
+                ),
+            )
+        else:
+            # Single-device remnant: the degenerate-mesh discipline
+            # from construction — no GSPMD, everything committed to the
+            # one survivor via SingleDeviceSharding (consistent
+            # placement, no phantom variants).
+            new_mesh = None
+            repl = SingleDeviceSharding(new_devices[0])
+            kv_sh = repl
+            self.variables = jax.device_put(self.variables, repl)
+        # Live-state migration: KV on the head axis per the plan;
+        # replicated members from a surviving replica.
+        self._caches = plan.migrate_tree(self._caches, kv_sh)
+        for name, block, (ck, _) in zip(
+            self.lm.block_names, self._blocks, self._caches
+        ):
+            # The partial-TP-migration check, by name, on per-SHARD
+            # geometry: migrate() rebuilds at the logical shape, so
+            # leaf.shape[1] can never disagree — what a plan bug
+            # produces is a shard holding the wrong head span. Each of
+            # the new_tp shards must carry exactly heads/new_tp rows.
+            leaf = ck[0] if isinstance(ck, tuple) else ck
+            shard_heads = leaf.addressable_shards[0].data.shape[1]
+            check_head_parity(block.cache_heads, shard_heads * new_tp)
+        self._dstate = plan.migrate_replicated(self._dstate, repl)
+        if self._spec:
+            self._draft_variables = plan.migrate_replicated(
+                self._draft_variables, repl
+            )
+            self._draft_caches = plan.migrate_replicated(
+                self._draft_caches, repl
+            )
+        # Install the shrunk layout; the page table re-uploads on the
+        # first post-recovery paged tick (placement changed even where
+        # the host table did not).
+        self._mesh = new_mesh
+        self._tp = new_tp
+        self._repl = repl
+        self._kv_sharding = kv_sh if new_mesh is not None else None
+        self._table_dev = None
+        self._table_snapshot = None
+        # Force a re-TRACE of every program whose jaxpr bakes concrete
+        # sharding constraints (jit caches traces on avals + statics —
+        # see _shard_kv), and drop the per-instance prefill closures so
+        # each bucket re-traces against the new layout on first use.
+        self._mesh_epoch += 1
+        prefill_dropped = sum(
+            f._cache_size() for f in self._prefill_cache.values()
+        )
+        self._prefill_cache.clear()
+        with self._cv:
+            # Consume only the losses THIS recovery handled: a device
+            # killed on another thread after the dead_ids() snapshot
+            # (its leave already queued against the old membership)
+            # must stay pending so the next tick recovers again —
+            # clear() would erase the event and leave a dead chip in
+            # the just-installed mesh.
+            consumed = {f"device:{i}" for i in lost_here}
+            self._lost_pending = [
+                k for k in self._lost_pending if k not in consumed
+            ]
+            self._mesh_device_ids = {int(d.id) for d in new_devices}
+            self._mesh_devices = list(new_devices)
+        # Re-lowering against the shrunk mesh is EXPECTED compilation,
+        # but LAZY — stage_slot pays on the next admission, a prefill
+        # bucket on its next use, possibly long after recovery — so
+        # each family gets an explicit expected-compile ALLOWANCE (not
+        # a warmup window that would re-close first): one re-lowered
+        # variant per STATIC-VARIANT KEY this batcher dispatched under
+        # the old epoch (every variant in use re-traces after the epoch
+        # bump — a mixed-traffic batcher holds several: step_chunk's
+        # (truncate, nucleus) combos, stage_slot's key buckets,
+        # _insert's prompt buckets), plus one per dropped prefill
+        # executable. Variants never re-used leave allowance slack on
+        # the shared watch (the cost of not knowing future traffic, as
+        # with prefill); anything beyond the allowance is still the
+        # phantom-variant alarm. Granted BEFORE the replay loop below:
+        # _replay_slot/_drop_slot dispatch the epoch-bumped _clear_slot
+        # inside it, and a concurrent exporter scrape sampling between
+        # that compile and a later rearm would fire a false alarm.
+        def nvar(prog: str) -> int:
+            # No floor: a family never dispatched under the old epoch
+            # had no executable to re-lower, and a banked allowance
+            # would mask one future REAL phantom variant (the same rule
+            # plain-paged _insert follows below).
+            return len(self._variants.get(prog, ()))
+
+        # _clear_slot re-lowers if it compiled under the old epoch, or
+        # compiles fresh on ANY occupied slot's account — the replay
+        # loop below dispatches it directly, a migrated slot's eventual
+        # _finish does too. Empty batcher + never compiled: NO banked
+        # allowance (the nvar rule — slack on a family recovery gives
+        # no reason to compile masks a real phantom).
+        will_clear = any(s.req is not None for s in self.slots)
+        expected = {
+            "continuous.stage_slot": nvar("continuous.stage_slot"),
+            "continuous.clear_slot": int(
+                bool(nvar("continuous.clear_slot")) or will_clear
+            ),
+            "continuous.prefill": prefill_dropped,
+        }
+        if not self._paged or self._spec:
+            # _insert dispatches only for dense admissions and the
+            # (always-dense) draft admission — a plain paged batcher
+            # inserts via _insert_paged and must not bank an allowance
+            # that would mask a later real phantom variant.
+            expected["continuous.insert"] = nvar("continuous.insert")
+        if self._spec:
+            expected["continuous.spec_verify"] = 1
+            expected["speculative.draft_chunk"] = 1
+        else:
+            expected["continuous.step_chunk"] = nvar(
+                "continuous.step_chunk"
+            )
+        for prog, n in expected.items():
+            if n:
+                self._sentinel.rearm(prog, expect=n)
+                self._granted[prog] = self._granted.get(prog, 0) + n
+        # Post-recovery dispatches repopulate against the new epoch —
+        # a second recovery must size from its own epoch's variants
+        # (the replay loop's _clear_slot dispatch is already one).
+        self._variants.clear()
+        self._roofline_costs = None  # stale: the program re-lowers
+        # Per-request policy: decoding slots migrate (their state just
+        # did, bit-exactly); mid-chunked-prefill slots — and everything
+        # under policy="replay" — replay from the journal instead.
+        migrated = replayed = dropped = 0
+        replay_ids: list[int] = []
+        replay_all = self._recovery.policy == "replay"
+        for slot in self.slots:
+            if slot.req is None:
+                continue
+            if replay_all or slot.pf_done >= 0:
+                rid = slot.req.req_id
+                try:
+                    self._replay_slot(slot)
+                    replayed += 1
+                    replay_ids.append(rid)
+                except Exception:  # noqa: BLE001 — drop, don't wedge
+                    if slot.req is None:
+                        # _replay_slot released the slot and re-queued
+                        # the request before failing (e.g. the final
+                        # slot-park dispatch): the replay IS in flight
+                        # — dropping here would deref a freed slot and
+                        # double-handle the queued request.
+                        log.exception(
+                            "replay of request %d raised after "
+                            "re-queue; replay proceeds", rid,
+                        )
+                        replayed += 1
+                        replay_ids.append(rid)
+                    else:
+                        log.exception(
+                            "replay failed for request %d; dropping",
+                            rid,
+                        )
+                        self._drop_slot(slot)
+                        dropped += 1
+            else:
+                migrated += 1
+                global_flight_recorder().record(
+                    "kv_migrated",
+                    request=slot.req.req_id,
+                    slot=slot.idx,
+                    tokens_kept=len(slot.tokens),
+                )
+        if len(replay_ids) > 1:
+            # Each _replay_slot appendleft'ed in slot order, inverting
+            # arrival order among the replays; restore FIFO (req_id is
+            # monotone in submit order) so the oldest in-flight request
+            # is not re-admitted last onto the shrunk — possibly
+            # halved-capacity — mesh. Rebuild by MEMBERSHIP, not by
+            # popping `replayed` entries: a client cancel() landing
+            # between a replay's re-queue and this reorder deletes its
+            # entry, and a blind popleft would then underflow or steal
+            # a non-replay request.
+            ids = set(replay_ids)
+            with self._cv:
+                head = sorted(
+                    (r for r in self._queue if r.req_id in ids),
+                    key=lambda r: r.req_id,
+                )
+                if head:
+                    rest = [
+                        r for r in self._queue if r.req_id not in ids
+                    ]
+                    self._queue.clear()
+                    self._queue.extend(head + rest)
+        wall = time.perf_counter() - t0
+        with self._cv:
+            self._recoveries += 1
+            self._recovery_migrated += migrated
+            self._recovery_replayed += replayed
+            self._recovery_dropped += dropped
+            self._last_recovery_wall_s = wall
+        reg = global_metrics()
+        reg.observe("recovery.wall_s", wall)
+        if migrated:
+            reg.inc("recovery.migrated_total", float(migrated))
+        if replayed:
+            reg.inc("recovery.replayed_total", float(replayed))
+        if dropped:
+            reg.inc("recovery.dropped_total", float(dropped))
+        summary = plan.summary()
+        summary.update(
+            migrated=migrated, replayed=replayed, dropped=dropped,
+            wall_s=wall,
+        )
+        global_flight_recorder().record(
+            "mesh_reshard",
+            old_tp=old_tp,
+            new_tp=new_tp,
+            lost=lost_here,
+            migrated=migrated,
+            replayed=replayed,
+            dropped=dropped,
+            moved_bytes=plan.moved_bytes,
+            host_staged_bytes=plan.host_staged_bytes,
+            wall_s=round(wall, 6),
+        )
+        log.warning(
+            "mesh reshard: tp %d -> %d (lost %s): %d migrated, "
+            "%d replayed, %d dropped in %.3fs",
+            old_tp, new_tp, lost_here, migrated, replayed, dropped, wall,
+        )
+        return summary
+
+    def _replay_slot(self, slot: _Slot) -> None:
+        """Replay one slot's request instead of migrating it: free the
+        slot (paged: its registered prompt pages drop into the prefix
+        LRU, so the re-admission re-enters through the prefix cache —
+        a suffix-only prefill instead of a full one), discard the
+        partial stream, and re-queue the request reconstructed from
+        the JOURNAL when one is configured (payload + sampling-knob
+        meta; the in-memory record is the fallback). Greedy replays
+        re-emit the identical stream; sampled ones re-use the
+        journaled key schedule — identical too."""
+        req = slot.req
+        # Tokens already DELIVERED to the client across this request's
+        # lives (a double-kill chain replays a replay: slot.tokens
+        # restarts at 0 each life, so the high-water mark carries).
+        delivered = max(req.stream_skip, len(slot.tokens))
+        # Snapshot the delivered stream so a cancel that lands before
+        # the re-run regenerates it can still resolve result() with
+        # what the client saw. Mid-regeneration (this life shorter than
+        # the last), the previous life's snapshot stays the truth.
+        if req.delivered_tokens is None or len(slot.tokens) >= len(
+            req.delivered_tokens
+        ):
+            req.delivered_tokens = np.asarray(slot.tokens, np.int32)
+            req.delivered_lps = np.asarray(slot.lps, np.float32)
+            if len(slot.tokens) > req.stream_skip:
+                # This life delivered NEW tokens, so its last commit is
+                # the client's latest delivery: the next new token's
+                # ITL measures from it. A life that only regenerated
+                # (double kill mid-catch-up) keeps the older stamp —
+                # the client received nothing since.
+                req.t_last_delivered = slot.t_last
+        source = "memory"
+        if self._journal is not None:
+            try:
+                payload = self._journal.read_payload(req.req_id)
+                meta = self._journal.submit_meta(req.req_id)
+                if meta is not None:
+                    req = _Request(
+                        req_id=req.req_id,
+                        prompt=np.asarray(payload, np.int32).reshape(-1),
+                        steps=int(meta["steps"]),
+                        temperature=float(meta["temperature"]),
+                        top_k=int(meta["top_k"]),
+                        top_p=float(meta["top_p"]),
+                        eos_id=meta["eos_id"],
+                        folded_keys=np.asarray(
+                            meta["folded_keys"], np.uint32
+                        ).reshape(-1, 2),
+                        stop=tuple(
+                            tuple(int(t) for t in s)
+                            for s in meta.get("stop", [])
+                        ),
+                        # Host-side attachments are not journalable;
+                        # they carry over from the live record.
+                        on_token=req.on_token,
+                        t_submit=req.t_submit,
+                        slo=req.slo,
+                        stream_skip=delivered,
+                        slo_violated=req.slo_violated,
+                        delivered_tokens=req.delivered_tokens,
+                        delivered_lps=req.delivered_lps,
+                        t_last_delivered=req.t_last_delivered,
+                    )
+                    source = "journal"
+            except Exception as e:  # noqa: BLE001 — fallback, loudly
+                log.warning(
+                    "journal replay of request %d fell back to the "
+                    "in-memory record: %r",
+                    req.req_id, e,
+                )
+        req.stream_skip = delivered  # memory-fallback path (no-op for
+        # the journal reconstruction, which was built with it)
+        req.t_requeued = time.perf_counter()
+        global_flight_recorder().record(
+            "replayed_from_journal",
+            request=req.req_id,
+            slot=slot.idx,
+            source=source,
+            tokens_discarded=len(slot.tokens),
+        )
+        with self._cv:
+            self._release_slot(slot)
+            self._queue.appendleft(req)
+            self._cv.notify_all()
+        self._park_slot_row(slot.idx)
+
+    def _drop_slot(self, slot: _Slot) -> None:
+        """Last resort when a replay cannot be constructed: the request
+        finishes with an empty result (a result() waiter unblocks with
+        the loss visible, never a timeout) and counts as dropped."""
+        req = slot.req
+        global_flight_recorder().record(
+            "request_dropped", request=req.req_id, slot=slot.idx
+        )
+        if self.obs_timeline:
+            # The same per-finish observations _finish records, so the
+            # latency histogram count and per-tenant verdict totals keep
+            # summing to the finish count. A drop delivered nothing —
+            # its verdict is missed regardless of budgets met so far.
+            global_metrics().observe(
+                "continuous.request_latency_s",
+                time.perf_counter() - req.t_submit,
+            )
+            if req.slo is not None:
+                global_metrics().inc(
+                    f"slo.missed_total.{req.slo.tenant}"
+                )
+        # A dropped request still FINISHES (once, reason="dropped"):
+        # the admit==finish lifecycle books and the
+        # stats()/continuous.completed mirrors must agree with _finish.
+        global_flight_recorder().record(
+            "finish", request=req.req_id, reason="dropped", tokens=0
+        )
+        with self._cv:
+            self._done[req.req_id] = np.zeros((0,), np.int32)
+            self._done_lps[req.req_id] = np.zeros((0,), np.float32)
+            self._cancelled.discard(req.req_id)
+            self._completed += 1
+            self._release_slot(slot)
+            self._cv.notify_all()
+        self._journal_done(req.req_id)
+        global_metrics().inc("continuous.completed")
+        self._park_slot_row(slot.idx)
+
+    def _journal_done(self, req_id: int) -> None:
+        """Done-mark a request in the journal (no-op without one; a
+        journal write failure must not poison the serving path)."""
+        if self._journal is None:
+            return
+        try:
+            self._journal.record_done(req_id)
+        except Exception as e:  # noqa: BLE001 — serving outlives the WAL
+            log.warning("journal done mark failed for %d: %r", req_id, e)
 
     def _slo_violation(
         self, slot: _Slot, budget: str, budget_s: float, measured_s: float
@@ -1467,6 +2196,7 @@ class ContinuousBatcher:
         already-missed request only move the attainment counters)."""
         if slot.slo_ok:
             slot.slo_ok = False
+            slot.req.slo_violated = True  # survives a recovery replay
             global_flight_recorder().record(
                 "slo_missed",
                 request=slot.req.req_id,
@@ -1533,6 +2263,37 @@ class ContinuousBatcher:
             good = sum(g for _, g in list(gs)[1:])
             reg.set_gauge("continuous.goodput_tokens_s", good / span)
 
+    def _release_slot(self, slot: _Slot) -> None:
+        """Reset one slot's host-side lifecycle state and return its
+        pages to the pool — caller holds ``_cv``. The SINGLE definition
+        ``_finish`` / ``_replay_slot`` / ``_drop_slot`` share, so a new
+        ``_Slot`` lifecycle field cannot silently diverge across the
+        three release paths."""
+        slot.req = None
+        slot.tokens = []
+        slot.lps = []
+        slot.pf_done = -1
+        slot.slo_ok = True
+        slot.t_first = 0.0
+        slot.obs_count = 0
+        if self._paged:
+            self._pager.free_slot(slot.idx)
+
+    def _park_slot_row(self, idx: int) -> None:
+        """Park a retired slot's device row (one donated setter
+        dispatch, outside the lock): active mask off + idle-sentinel
+        position, so the next chunk's garbage writes route to the
+        trash strip / trash page again. The SINGLE ``_clear_slot``
+        dispatch site ``_finish`` / ``_replay_slot`` / ``_drop_slot``
+        share — it also books the family into ``_variants`` so
+        ``recover()`` knows an old-epoch executable exists to
+        re-lower."""
+        self._variants.setdefault("continuous.clear_slot", set()).add(0)
+        self._dstate = self._clear_slot(
+            self._dstate, self._h2d(np.int32(idx)),
+            epoch=self._mesh_epoch,
+        )
+
     def _finish(self, slot: _Slot, reason: str = "completed") -> None:
         req = slot.req
         if self.obs_timeline:
@@ -1547,6 +2308,15 @@ class ContinuousBatcher:
                 global_metrics().inc(
                     f"slo.{kind}_total.{req.slo.tenant}"
                 )
+        toks = np.asarray(slot.tokens, np.int32)
+        lps = np.asarray(slot.lps, np.float32)
+        if req.delivered_tokens is not None and len(toks) < len(
+            req.delivered_tokens
+        ):
+            # A replay cancelled mid-regeneration holds fewer tokens in
+            # THIS life than the client received in the last; result()
+            # must never contradict the delivered stream.
+            toks, lps = req.delivered_tokens, req.delivered_lps
         # Flight events stay UNGATED like cancel's: the recorder's
         # contract is always-on per-lifecycle — a post-mortem must not
         # show cancels for requests with no admit/finish.
@@ -1554,11 +2324,11 @@ class ContinuousBatcher:
             "finish",
             request=req.req_id,
             reason=reason,
-            tokens=len(slot.tokens),
+            tokens=len(toks),
         )
         with self._cv:
-            self._done[req.req_id] = np.asarray(slot.tokens, np.int32)
-            self._done_lps[req.req_id] = np.asarray(slot.lps, np.float32)
+            self._done[req.req_id] = toks
+            self._done_lps[req.req_id] = lps
             while len(self._done_lps) > self._LPS_CAP:
                 evicted = next(iter(self._done_lps))
                 self._done_lps.pop(evicted)
@@ -1573,23 +2343,11 @@ class ContinuousBatcher:
             # stats() can't observe "finished but still counted active"
             # (the torn triple an unlocked _completed/slot.req allowed).
             self._completed += 1
-            slot.req = None
-            slot.tokens = []
-            slot.lps = []
-            slot.pf_done = -1
-            slot.slo_ok = True
-            if self._paged:
-                # Pages return to the pool the moment the request
-                # retires — the capacity win continuous paging exists
-                # for.
-                self._pager.free_slot(slot.idx)
-        # Park the slot's device row (one donated setter dispatch,
-        # outside the lock): active mask off + idle-sentinel position,
-        # so the next chunk's garbage writes route to the trash strip /
-        # trash page again.
-        self._dstate = self._clear_slot(
-            self._dstate, self._h2d(np.int32(slot.idx))
-        )
+            # Pages return to the pool the moment the request retires —
+            # the capacity win continuous paging exists for.
+            self._release_slot(slot)
+        self._journal_done(req.req_id)
+        self._park_slot_row(slot.idx)
         global_metrics().inc("continuous.completed")
 
     def _commit(self, slot: _Slot, token: int, lp: float) -> None:
@@ -1615,9 +2373,14 @@ class ContinuousBatcher:
             # token, ITL only when the previous commit also stamped.
             now = time.perf_counter()
             emitted_before = len(slot.tokens)
+            # A replay's regenerated prefix (indices < stream_skip) was
+            # already delivered, stamped and counted in the request's
+            # first life: it re-runs for state only — no second TTFT,
+            # no ITL samples, no goodput/attainment movement.
+            regen = emitted_before < req.stream_skip
             if slot.t_first == 0.0:
                 slot.t_first = now
-                if emitted_before == 0:
+                if emitted_before == 0 and req.stream_skip == 0:
                     ttft = now - req.t_submit
                     global_metrics().observe("continuous.ttft_s", ttft)
                     if req.slo is not None and (
@@ -1630,8 +2393,19 @@ class ContinuousBatcher:
                             self._slo_violation(
                                 slot, "ttft", req.slo.ttft_budget_s, ttft
                             )
-            elif slot.obs_count == emitted_before:
-                gap = now - slot.t_last
+            elif slot.obs_count == emitted_before and not regen:
+                if (
+                    emitted_before == req.stream_skip
+                    and req.t_last_delivered != 0.0
+                ):
+                    # First NEW token after a replay: the client's
+                    # previous token landed before the kill, so the gap
+                    # spans kill + recovery + re-prefill + regeneration
+                    # — the stall the client actually saw, judged like
+                    # a migrated request's recovery wall is.
+                    gap = now - req.t_last_delivered
+                else:
+                    gap = now - slot.t_last
                 self._itl_pending.append(gap)
                 if req.slo is not None and (
                     req.slo.itl_budget_s is not None
@@ -1649,12 +2423,17 @@ class ContinuousBatcher:
             # whether its request is still inside budget (no-SLO
             # requests have nothing to violate and stay good). Plain
             # int incs here; the registry sees one flush per tick.
-            self._tick_tokens += 1
-            if slot.slo_ok:
-                self._tick_good_tokens += 1
+            if not regen:
+                self._tick_tokens += 1
+                if slot.slo_ok:
+                    self._tick_good_tokens += 1
         slot.tokens.append(token)
         slot.lps.append(lp)
-        if req.on_token is not None:
+        if req.on_token is not None and len(slot.tokens) > req.stream_skip:
+            # stream_skip suppresses re-delivery of the indices a
+            # replayed request already streamed pre-kill (the re-run
+            # regenerates them identically) — on_token stays
+            # exactly-once even across a recovery replay.
             req.on_token(req.req_id, token, len(slot.tokens) - 1)
         if req.eos_id is not None and token == req.eos_id:
             # generate() pads with EOS forever after; a server frees the
@@ -1785,6 +2564,9 @@ class ContinuousBatcher:
                     # Pad each block's (1, h, bucket, hd) K/V to the
                     # cache length happens inside _insert via
                     # dynamic_update_slice bounds.
+                    self._variants.setdefault(
+                        "continuous.insert", set()
+                    ).add(bucket)
                     self._caches = self._insert(
                         self._caches, self._h2d(np.int32(i)), kvs
                     )
@@ -1815,7 +2597,9 @@ class ContinuousBatcher:
             slot.lps = []
             slot.t_first = 0.0  # timeline: no token emitted yet
             slot.obs_count = 0
-            slot.slo_ok = True
+            # A replayed request that already missed its budget stays
+            # missed — its client experienced the violation.
+            slot.slo_ok = not req.slo_violated
             slot.pf_done = m * self._page if chunked else -1
             with self._cv:
                 self._admitting = None  # slot-bound: visible to cancel()
@@ -1829,7 +2613,12 @@ class ContinuousBatcher:
                 global_metrics().observe(
                     "paged.pages_reused_per_admission", float(m)
                 )
-            queue_wait = time.perf_counter() - req.t_submit
+            # A replay's wait measures from its re-queue, not from the
+            # original submit (that span is first-life decode plus the
+            # recovery wall, not time spent queued).
+            queue_wait = time.perf_counter() - (
+                req.t_requeued or req.t_submit
+            )
             if self.obs_timeline:
                 global_metrics().observe(
                     "continuous.queue_wait_s", queue_wait
@@ -1883,11 +2672,13 @@ class ContinuousBatcher:
             np.int32,
         )
         floats = np.array([req.temperature, req.top_p], np.float32)
+        self._variants.setdefault("continuous.stage_slot", set()).add(nkb)
         self._dstate = self._stage_slot(
             self._dstate,
             self._h2d(ints),
             self._h2d(floats),
             self._h2d(kbuf),
+            epoch=self._mesh_epoch,
         )
 
     def _current_table(self):
@@ -2023,6 +2814,7 @@ class ContinuousBatcher:
             self._dstate,
             dtoks,
             self._current_table() if self._paged else None,
+            epoch=self._mesh_epoch,
         )
         with self._cv:
             self._ticks += 1
@@ -2083,6 +2875,21 @@ class ContinuousBatcher:
         costs one branch. The compile sentinel samples once at the end
         of every tick, so an unexpected recompile is flagged next to
         the tick that paid for it."""
+        if self._lost_pending:
+            # A mesh device died since the last tick: recover BEFORE
+            # dispatching anything onto the broken layout. Under
+            # auto_reshard the tick re-shards inline and proceeds on
+            # the shrunk mesh; otherwise every dispatch raises until
+            # recover() is called.
+            if self._recovery.auto_reshard:
+                self.recover()
+            else:
+                with self._cv:
+                    lost = list(self._lost_pending)
+                raise DeviceLostError(
+                    f"mesh device(s) lost: {lost} — auto_reshard is "
+                    "off; call recover()"
+                )
         eo = self._eobs
         # Snapshot the gate ONCE per tick (see _spec_decode).
         eo_on = eo.enabled
@@ -2151,6 +2958,9 @@ class ContinuousBatcher:
             # changed.
             truncate = any(s.req.top_k < self.lm.vocab for s in active)
             nucleus = any(s.req.top_p < 1.0 for s in active)
+            self._variants.setdefault("continuous.step_chunk", set()).add(
+                (truncate, nucleus)
+            )
             t_chunk = tracer.now() if tracer.enabled else 0.0
             toks, lps, self._caches, self._dstate = self._step_chunk(
                 self.variables,
@@ -2159,6 +2969,7 @@ class ContinuousBatcher:
                 self._current_table() if self._paged else None,
                 truncate=truncate,
                 nucleus=nucleus,
+                epoch=self._mesh_epoch,
             )
             with self._cv:
                 self._ticks += 1
@@ -2276,6 +3087,14 @@ class ContinuousBatcher:
                     x.nbytes for x in jax.tree.leaves(self._caches)
                 ) / float(self._native_cache_bytes),
                 "tp": self._tp,
+                # Elastic-recovery books (instance-lifetime mirrors of
+                # the recovery.* registry counters; wall_s is the most
+                # recent recovery's detection->migrated span).
+                "recoveries": self._recoveries,
+                "recovery_migrated": self._recovery_migrated,
+                "recovery_replayed": self._recovery_replayed,
+                "recovery_dropped": self._recovery_dropped,
+                "last_recovery_wall_s": self._last_recovery_wall_s,
                 # SLO attainment books (instance-lifetime, flushed
                 # per tick — mirrors of the slo.* registry counters).
                 "slo_ttft_met": self._slo_totals["ttft_met"],
@@ -2404,12 +3223,14 @@ class ContinuousBatcher:
                 costs["verify"] = program_cost_analysis(
                     type(self)._spec_verify,
                     self, a_vars, a_caches, a_dstate, a_dtoks, a_table,
+                    epoch=self._mesh_epoch,
                 )
             else:
                 costs["decode"] = program_cost_analysis(
                     type(self)._step_chunk,
                     self, a_vars, a_caches, a_dstate, a_table,
                     truncate=False, nucleus=False,
+                    epoch=self._mesh_epoch,
                 )
         except Exception as e:  # noqa: BLE001 — degrade, don't break scrape
             log.info("roofline cost analysis unavailable: %r", e)
@@ -2547,6 +3368,16 @@ class ContinuousBatcher:
         unregister_memory_source("continuous", self)
         unregister_roofline_source("continuous", self)
         _LIVE_BATCHERS.discard(self)
+        self._retired = True  # stop consuming membership events
+        # Revoke this batcher's unconsumed recovery allowances: the
+        # class-level watches outlive it, and leftover slack (a family
+        # recovery expected to re-lower but traffic never exercised)
+        # would silently absorb ANOTHER live batcher's real phantom
+        # variant. Consumed units are already gone, so disarming the
+        # full grant strips exactly the leftovers.
+        for prog, n in self._granted.items():
+            self._sentinel.disarm(prog, n)
+        self._granted.clear()
 
     def result(self, req_id: int, timeout: float = 300.0) -> np.ndarray:
         """Block until ``req_id`` finishes (requires :meth:`start`);

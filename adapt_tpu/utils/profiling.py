@@ -91,12 +91,16 @@ log = get_logger("profiling")
 
 
 class _Watch:
-    __slots__ = ("size_fn", "last", "samples")
+    __slots__ = ("size_fn", "last", "samples", "expected")
 
     def __init__(self, size_fn: Callable[[], int]):
         self.size_fn = size_fn
         self.last: int | None = None
         self.samples = 0
+        #: Outstanding EXPECTED-compile allowance (:meth:`rearm`):
+        #: post-warmup growth is absorbed against it, one executable
+        #: per unit, before anything is flagged as unexpected.
+        self.expected = 0
 
 
 class CompileSentinel:
@@ -172,13 +176,60 @@ class CompileSentinel:
                 )
             size_fn = fn._cache_size
         with self._lock:
-            self._watches[name] = _Watch(size_fn)
+            w = _Watch(size_fn)
+            prev = self._watches.get(name)
+            if prev is not None:
+                # An outstanding expected-compile allowance (rearm)
+                # survives re-registration: a second instance's
+                # construction must not erase the first one's pending
+                # planned re-lowering and turn it into a false alarm.
+                w.expected = prev.expected
+            self._watches[name] = w
             self._pruned.discard(name)
 
     def unregister(self, name: str) -> None:
         with self._lock:
             if self._watches.pop(name, None) is not None:
                 self._pruned.add(name)
+
+    def rearm(self, name: str, expect: int = 1) -> None:
+        """Grant ``name`` an allowance of ``expect`` EXPECTED compiles
+        — for planned re-lowering events. Elastic mesh recovery
+        re-lowers every program family against the shrunk mesh, but
+        lazily (stage_slot on the next admission, a prefill bucket on
+        its next use — possibly long after any warmup window would
+        have re-closed), so the allowance is consumed whenever the
+        growth actually lands: the next ``expect`` new executables are
+        absorbed without an event, and anything beyond them is the
+        phantom-variant alarm the sentinel exists for. Unknown names
+        are a no-op (a spec-less batcher re-arms no draft watch).
+
+        Caveat, same as re-registration's warmup re-arm: watches on
+        class-level shared jits see every live instance, so an
+        allowance granted for one batcher's recovery can absorb
+        another's growth until consumed — grant only compiles the
+        caller is confident will land (the batcher scopes its grants
+        to the program families it actually dispatches)."""
+        with self._lock:
+            w = self._watches.get(name)
+            if w is not None:
+                w.expected += expect
+
+    def disarm(self, name: str, expect: int = 1) -> None:
+        """Revoke up to ``expect`` units of ``name``'s outstanding
+        allowance (clamped at zero; unknown names are a no-op). A
+        granter that retires before its planned re-lowering lands MUST
+        call this with its full grant — consumed units are already
+        subtracted, so the clamp removes exactly the leftover — or the
+        slack survives on the shared class-level watch and silently
+        absorbs another instance's REAL phantom variant. With
+        concurrent granters the clamp can bite into another's pending
+        allowance (same shared-watch caveat as :meth:`rearm`): the
+        failure direction is a spurious alarm, never a masked one."""
+        with self._lock:
+            w = self._watches.get(name)
+            if w is not None:
+                w.expected = max(0, w.expected - expect)
 
     def watched(self) -> list[str]:
         with self._lock:
@@ -258,6 +309,15 @@ class CompileSentinel:
                     continue
                 delta = size - w.last
                 w.last = size
+                if delta > 0 and warmed and w.expected > 0:
+                    # Planned re-lowering (rearm): absorb the expected
+                    # executables; only the excess can fire. Warmup-
+                    # covered growth is already silent and must NOT
+                    # spend the allowance — the planned compile it was
+                    # banked for may land later, post-warmup.
+                    absorbed = min(delta, w.expected)
+                    w.expected -= absorbed
+                    delta -= absorbed
                 if delta > 0 and warmed:
                     fired.append((name, size, delta))
                     self._events += delta

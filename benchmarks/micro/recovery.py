@@ -1,0 +1,150 @@
+"""Elastic-recovery micro-benchmark: kill one simulated device of a
+tp=4 serving mesh mid-stream and measure recovery-to-decode.
+
+The ROADMAP pin this drives is the source paper's headline: "<2 s
+recovery after one stage kill" — applied to the TENSOR-PARALLEL request
+tier (the remote/pipeline tier has its own driver,
+``benchmarks/recovery.py``). One run:
+
+1. build a tp=4 ``ContinuousBatcher`` on the virtual CPU mesh, admit
+   ``--slots`` requests, run ``--ticks`` steady ticks;
+2. ``DeviceHealthMonitor.kill`` one mesh device and time
+   **kill -> the first post-recovery tick returning** — detection,
+   mesh rebuild (tp=4 -> tp=2), weight re-placement, the explicit
+   KV redistribution plan (``parallel.sharding.KVReshardPlan``), AND
+   the re-lowering compile of the shrunk decode program: the full
+   recovery-to-serving wall;
+3. drain, and compare every stream against an uninterrupted tp=4 run.
+
+Reported records (multi-record driver; both gated in
+``benchmarks/baselines/seed.json``):
+
+- ``micro_recovery_wall_s`` — the kill->first-tick wall (the <2 s
+  budget, sized for CPU re-compile cost; ``reshard_s`` extra carries
+  the migration-only span from ``stats()``);
+- ``micro_recovery_migrated`` — requests migrated live (STRUCTURAL:
+  must equal the in-flight count; replayed/dropped must be 0 under
+  the default migrate policy).
+
+Any bit-identity violation or structural mismatch (tp != 2, books
+wrong) becomes an ``error`` record the gate always fails.
+
+Usage: ``python benchmarks/micro/recovery.py [--slots 3] [--ticks 2]``
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks.common import emit, force_cpu_mesh, int_flag  # noqa: E402
+
+_NDEV = 4
+
+
+def main() -> int:
+    slots = int_flag(sys.argv, "--slots", 3)
+    n_ticks = int_flag(sys.argv, "--ticks", 2)
+    try:
+        force_cpu_mesh(_NDEV)
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from adapt_tpu.config import ParallelConfig
+        from adapt_tpu.control.registry import DeviceHealthMonitor
+        from adapt_tpu.models.transformer_lm import transformer_lm
+        from adapt_tpu.runtime.continuous import ContinuousBatcher
+        from adapt_tpu.utils.profiling import global_compile_sentinel
+
+        lm = transformer_lm(61, 64, 2, 8, 128, max_len=128, kv_heads=4)
+        variables = lm.graph.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+        )
+        # The driver deliberately provokes legitimate compiles (two
+        # batcher instances + the re-lowered post-recovery variants,
+        # which recover() re-arms anyway); disarm the alarm so honest
+        # runs don't bump engine.compile_events (tp_decode's rule).
+        global_compile_sentinel().warmup_samples = 10**9
+        rng = np.random.RandomState(0)
+        prompts = [
+            rng.randint(0, 61, size=4 + 2 * i).astype(np.int32)
+            for i in range(slots)
+        ]
+        steps = [n_ticks * 8 + 24 + 4 * i for i in range(slots)]
+
+        def run(kill: bool):
+            mesh = Mesh(np.array(jax.devices()[:_NDEV]), ("tp",))
+            mon = DeviceHealthMonitor()
+            bat = ContinuousBatcher(
+                lm, variables, slots=slots, chunk=8, mesh=mesh,
+                parallel=ParallelConfig(tp=4), health=mon,
+            )
+            ids = [bat.submit(p, s) for p, s in zip(prompts, steps)]
+            for _ in range(n_ticks):
+                bat.tick()
+            wall = None
+            if kill:
+                mon.kill(jax.devices()[_NDEV - 1])
+                t0 = time.perf_counter()
+                bat.tick()  # detect -> reshard -> decode on tp=2
+                wall = time.perf_counter() - t0
+            out = bat.run()
+            st = bat.stats()
+            bat.close()
+            return [out[r] for r in ids], st, wall
+
+        base, _, _ = run(False)
+        got, st, wall = run(True)
+        errors: list[str] = []
+        for i, (a, b) in enumerate(zip(base, got)):
+            if not np.array_equal(a, b):
+                errors.append(f"req {i} diverged after recovery")
+        if st["tp"] != 2:
+            errors.append(f"tp after reshard: {st['tp']} != 2")
+        if st["recoveries"] != 1:
+            errors.append(f"recoveries {st['recoveries']} != 1")
+        if st["recovery_replayed"] or st["recovery_dropped"]:
+            errors.append(
+                f"migrate policy replayed {st['recovery_replayed']} / "
+                f"dropped {st['recovery_dropped']} (expected 0/0)"
+            )
+        if st["cache_bytes_per_device"] * 2 != st["cache_bytes"]:
+            errors.append(
+                f"per-device bytes {st['cache_bytes_per_device']} * 2 "
+                f"!= logical {st['cache_bytes']}"
+            )
+        extras = {
+            "migrated": st["recovery_migrated"],
+            "replayed": st["recovery_replayed"],
+            "dropped": st["recovery_dropped"],
+            "reshard_s": round(st["last_recovery_wall_s"], 4),
+            "tp_after": st["tp"],
+            "slots": slots,
+        }
+        if errors:
+            emit(
+                "micro_recovery_wall_s", 0.0, "s", 0.0,
+                error="; ".join(errors)[-300:], **extras,
+            )
+            return 0
+        emit("micro_recovery_wall_s", wall, "s", wall, **extras)
+        emit(
+            "micro_recovery_migrated",
+            float(st["recovery_migrated"]),
+            "requests",
+            0.0,
+            slots=slots,
+        )
+    except Exception as e:  # noqa: BLE001 — always one JSON line, rc 0
+        emit("micro_recovery_wall_s", 0.0, "s", 0.0, error=str(e)[-300:])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -177,6 +177,34 @@ def test_sentinel_reregister_rearms_warmup():
     assert sent.events == 0
 
 
+def test_sentinel_disarm_revokes_unconsumed_allowance():
+    """A granter that retires before its planned re-lowering lands must
+    be able to take the allowance back: leftover slack on the shared
+    watch would silently absorb another instance's REAL phantom
+    variant (the batcher's close() calls disarm with its full grant;
+    consumed units are already subtracted, so the clamp at zero strips
+    exactly the leftovers)."""
+    sent = CompileSentinel(warmup_samples=1)
+
+    @jax.jit
+    def toy(x):
+        return x + 3
+
+    sent.register("toy", toy)
+    toy(jnp.zeros((2,), jnp.float32))
+    sent.sample()
+    sent.sample()  # warmed
+    sent.rearm("toy", expect=2)  # planned re-lowering, never lands
+    sent.disarm("toy", expect=2)  # granter retires: full grant back
+    toy(jnp.zeros((5,), jnp.float32))  # REAL phantom variant
+    assert sent.sample() == 1, "revoked allowance still absorbed growth"
+    assert sent.events == 1
+    sent.disarm("toy", expect=5)  # over-disarm clamps at zero...
+    sent.disarm("missing")  # ...and unknown names are a no-op
+    toy(jnp.zeros((7,), jnp.float32))
+    assert sent.sample() == 1  # clamp did not go negative
+
+
 def test_batcher_forced_shape_change_fires_sentinel(lm_setup):
     """Acceptance pin: a forced shape change after warmup increments
     ``engine.compile_events`` and records a flight-recorder event —
