@@ -394,6 +394,32 @@ def pack(codec: Codec, x) -> bytearray:
     return buf
 
 
+def unpack_many(buf, lens: list[int],
+                tolerance: float | None = None) -> list[np.ndarray]:
+    """Decode CONCATENATED :func:`pack` frames whose per-frame byte
+    lengths are carried out of band (``lens`` — e.g. the
+    disaggregated-serving page-range annex). Each frame decodes through
+    :func:`unpack`, so ``raw`` frames return arrays VIEWING ``buf``
+    (the zero-copy receive contract, per frame). Raises
+    ``ValueError`` when ``lens`` does not tile ``buf`` exactly — a
+    truncated or padded stream must fail loudly, not decode garbage."""
+    mv = _byte_view(buf)
+    out, off = [], 0
+    for n in lens:
+        if n < 0 or off + n > mv.nbytes:
+            raise ValueError(
+                f"frame length {n} at offset {off} overruns the "
+                f"{mv.nbytes}-byte buffer"
+            )
+        out.append(unpack(mv[off : off + n], tolerance))
+        off += n
+    if off != mv.nbytes:
+        raise ValueError(
+            f"frame lengths cover {off} of {mv.nbytes} payload bytes"
+        )
+    return out
+
+
 def unpack(buf, tolerance: float | None = None) -> np.ndarray:
     """Decode a :func:`pack` frame. Slices with memoryviews, so the codec
     sees a VIEW of ``buf`` and ``raw`` decode returns an array sharing

@@ -29,6 +29,20 @@ for this request (``utils.tracing.export_spans``) — without disturbing
 the payload's zero-copy contract: the annex rides length-prefixed
 BEFORE the payload, so the payload remains one contiguous view of the
 receive buffer. Cost when unused: one flags byte per frame.
+
+Page-range annex (``FLAG_PAGE_ANNEX``): the disaggregated-serving KV
+handoff (``runtime/disagg``) describes its payload — concatenated
+codec frames holding whole KV-cache PAGES — in a second
+length-prefixed annex (page count, per-tensor frame lengths, layout
+geometry) that rides after the trace annex, still ahead of the
+payload. Same contract: the page chunks themselves stay scatter-write
+parts on send and one contiguous view on receive; the annex is the
+only part that is parsed.
+
+``frame_parts`` / ``parse_frame`` are the pure halves of
+``send_msg`` / ``recv_msg`` — in-process transports (the
+disaggregated handoff loopback) and tests reuse them so the wire
+format cannot fork from the socket paths.
 """
 
 from __future__ import annotations
@@ -45,6 +59,13 @@ MSG_CONFIG = 2
 MSG_RESULT = 3
 MSG_ACK = 4
 MSG_ERROR = 5
+#: Disaggregated-serving KV handoff frame (``runtime/disagg``): payload
+#: is concatenated codec frames of whole KV pages, described by the
+#: page-range annex. (6..17 are claimed by ``comm.remote`` —
+#: MSG_SET_ROUTE=16 / MSG_DATA_CHAINED=17 live there; the type byte is
+#: ONE namespace across both modules, so new types must collide with
+#: neither.)
+MSG_KV_PAGES = 18
 
 #: header: type, stage_index (signed: canary probes use PING_STAGE = -1),
 #: request_id (signed: probe ids are negative, disjoint from requests),
@@ -55,8 +76,11 @@ _ANNEX_LEN = struct.Struct(">I")
 
 #: Flags-byte bits. TRACE_ANNEX: a u32-length-prefixed span blob
 #: precedes the payload (stitched back into the dispatcher's trace by
-#: ``comm.remote.RemoteWorkerProxy``).
+#: ``comm.remote.RemoteWorkerProxy``). PAGE_ANNEX: a u32-length-
+#: prefixed page-range blob (``runtime/disagg`` KV handoff metadata)
+#: follows the trace annex (if any), still ahead of the payload.
 FLAG_TRACE_ANNEX = 0x01
+FLAG_PAGE_ANNEX = 0x02
 
 #: The reference's ACK byte (src/dispatcher.py:250-260, src/node.py:52,88).
 ACK_BYTE = b"\x06"
@@ -102,6 +126,9 @@ class Message:
     #: flags byte is DERIVED from its presence — senders just set
     #: ``annex``; receivers see ``bytes`` or None.
     annex: bytes | None = None
+    #: Optional page-range blob (disaggregated KV handoff metadata,
+    #: ``runtime/disagg``). Same derived-flag rule as ``annex``.
+    page_annex: bytes | None = None
 
 
 def _sendmsg_all(sock: socket.socket, parts: list[memoryview]) -> None:
@@ -127,21 +154,33 @@ def _sendmsg_all(sock: socket.socket, parts: list[memoryview]) -> None:
             parts[0] = parts[0][sent:]
 
 
-def send_msg(sock: socket.socket, msg: Message) -> None:
+def frame_parts(msg: Message) -> list[memoryview]:
+    """The frame as scatter-write parts: ``[length prefix + header
+    (+ annexes), *payload views]`` — the pure half of :func:`send_msg`,
+    shared with in-process transports (the disaggregated KV-handoff
+    loopback) so the wire layout has ONE definition. Zero payload
+    copies: the views alias the caller's buffers."""
     parts = _payload_parts(msg.payload)
     flags = 0
     head_extra = b""
     if msg.annex is not None:
         flags |= FLAG_TRACE_ANNEX
-        head_extra = _ANNEX_LEN.pack(len(msg.annex)) + msg.annex
+        head_extra += _ANNEX_LEN.pack(len(msg.annex)) + msg.annex
+    if msg.page_annex is not None:
+        flags |= FLAG_PAGE_ANNEX
+        head_extra += _ANNEX_LEN.pack(len(msg.page_annex)) + msg.page_annex
     total = _HEADER.size + len(head_extra) + sum(p.nbytes for p in parts)
     header = _LEN.pack(total) + _HEADER.pack(
         msg.msg_type, msg.stage_index, msg.request_id, msg.attempt, flags
     ) + head_extra
-    # One gather write: prefix+header (+ annex) and every payload part
+    return [memoryview(header), *parts]
+
+
+def send_msg(sock: socket.socket, msg: Message) -> None:
+    # One gather write: prefix+header (+ annexes) and every payload part
     # go to the kernel as-is — zero host-side concatenation of the
     # payload.
-    _sendmsg_all(sock, [memoryview(header), *parts])
+    _sendmsg_all(sock, frame_parts(msg))
 
 
 def _recv_exact_into(
@@ -165,6 +204,46 @@ def _recv_exact_into(
         off += got
 
 
+def parse_frame(buf) -> Message:
+    """Parse one frame BODY (everything after the 8-byte length prefix)
+    into a :class:`Message` — the pure half of :func:`recv_msg`, shared
+    with in-process transports. The payload is a memoryview of ``buf``
+    (zero-copy: ``codec.unpack`` arrays share its memory); the annexes
+    are materialized bytes (small, parsed)."""
+    total = len(buf)
+    if total < _HEADER.size:
+        raise ConnectionError(f"short frame: {total}")
+    msg_type, stage_index, request_id, attempt, flags = _HEADER.unpack_from(
+        buf
+    )
+    off = _HEADER.size
+
+    def annex_at(off: int) -> tuple[bytes, int]:
+        if total < off + _ANNEX_LEN.size:
+            raise ConnectionError(f"short annexed frame: {total}")
+        (alen,) = _ANNEX_LEN.unpack_from(buf, off)
+        off += _ANNEX_LEN.size
+        if total < off + alen:
+            raise ConnectionError(f"annex overruns frame: {alen}")
+        return bytes(buf[off : off + alen]), off + alen
+
+    annex: bytes | None = None
+    page_annex: bytes | None = None
+    if flags & FLAG_TRACE_ANNEX:
+        annex, off = annex_at(off)
+    if flags & FLAG_PAGE_ANNEX:
+        page_annex, off = annex_at(off)
+    return Message(
+        msg_type=msg_type,
+        stage_index=stage_index,
+        request_id=request_id,
+        attempt=attempt,
+        payload=memoryview(buf)[off:],
+        annex=annex,
+        page_annex=page_annex,
+    )
+
+
 def recv_msg(sock: socket.socket, retry_on_timeout: bool = True) -> Message:
     """``retry_on_timeout=False`` turns the socket's timeout into a hard
     receive deadline (used where a silent peer must not hold a serial
@@ -178,25 +257,4 @@ def recv_msg(sock: socket.socket, retry_on_timeout: bool = True) -> Message:
         raise ConnectionError(f"short frame: {total}")
     buf = bytearray(total)
     _recv_exact_into(sock, memoryview(buf), retry_on_timeout)
-    msg_type, stage_index, request_id, attempt, flags = _HEADER.unpack_from(
-        buf
-    )
-    off = _HEADER.size
-    annex: bytes | None = None
-    if flags & FLAG_TRACE_ANNEX:
-        if total < off + _ANNEX_LEN.size:
-            raise ConnectionError(f"short annexed frame: {total}")
-        (alen,) = _ANNEX_LEN.unpack_from(buf, off)
-        off += _ANNEX_LEN.size
-        if total < off + alen:
-            raise ConnectionError(f"annex overruns frame: {alen}")
-        annex = bytes(buf[off : off + alen])
-        off += alen
-    return Message(
-        msg_type=msg_type,
-        stage_index=stage_index,
-        request_id=request_id,
-        attempt=attempt,
-        payload=memoryview(buf)[off:],
-        annex=annex,
-    )
+    return parse_frame(buf)

@@ -227,6 +227,72 @@ class RecoveryConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class DisaggConfig:
+    """Disaggregated prefill/decode serving (``runtime/disagg``;
+    ``docs/SERVING.md`` "Disaggregated prefill/decode").
+
+    Production fleets split compute-bound PREFILL from latency-bound
+    DECODE onto separate pools so a long prompt's admission never runs
+    inside a decode tick (the decode-stall pathology the load harness
+    measures as ``continuous.prefill_stall_s``). The
+    ``runtime.disagg.DisaggServer`` placement policy decides PER
+    REQUEST between the collocated path (ordinary
+    ``ContinuousBatcher.submit`` — prefill runs in the decode tick) and
+    the disaggregated path (a ``PrefillWorker`` prefills the prompt's
+    full pages against its own pool and streams the KV pages to the
+    decode batcher over the comm tier, where they land through the
+    paged prefix cache):
+
+    - prompts of at least ``prompt_threshold`` tokens always
+      disaggregate (their inline prefill wall is the p99 ITL spike);
+    - when the decode tier is BUSY (occupied slots / total slots >=
+      ``busy_occupancy``), the threshold drops to
+      ``busy_prompt_threshold`` — under load, even mid-length prefills
+      steal decode ticks someone is waiting on;
+    - everything shorter collocates: the handoff costs one page-stream
+      + one suffix pass, which a short prompt's inline prefill
+      undercuts.
+
+    The policy also falls back to collocated whenever the prefill
+    tier cannot take the request (pool pressure, a dead role-tagged
+    lease, a prompt without one full page) — placement is an
+    optimization, never a correctness gate."""
+
+    #: Prompts with at least this many tokens always take the
+    #: disaggregated path (when one exists). Must exceed the decode
+    #: pool's page size — a prompt with no full page has nothing to
+    #: hand off.
+    prompt_threshold: int = 256
+    #: Threshold applied instead when the decode tier is busy.
+    busy_prompt_threshold: int = 64
+    #: Decode-slot occupancy fraction at/above which the tier counts
+    #: as busy.
+    busy_occupancy: float = 0.75
+
+    def __post_init__(self):
+        if self.prompt_threshold < 1:
+            raise ValueError(
+                f"prompt_threshold must be >= 1, got "
+                f"{self.prompt_threshold}"
+            )
+        if self.busy_prompt_threshold < 1:
+            raise ValueError(
+                f"busy_prompt_threshold must be >= 1, got "
+                f"{self.busy_prompt_threshold}"
+            )
+        if self.busy_prompt_threshold > self.prompt_threshold:
+            raise ValueError(
+                "busy_prompt_threshold must not exceed prompt_threshold "
+                f"({self.busy_prompt_threshold} > {self.prompt_threshold})"
+            )
+        if not 0.0 <= self.busy_occupancy <= 1.0:
+            raise ValueError(
+                f"busy_occupancy must be in [0, 1], got "
+                f"{self.busy_occupancy}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class SLOSpec:
     """Per-request latency budget, evaluated by the serving tier's
     existing lifecycle stamps (``runtime/continuous`` request
@@ -336,4 +402,7 @@ class ServeConfig:
     )
     recovery: RecoveryConfig = dataclasses.field(
         default_factory=RecoveryConfig
+    )
+    disagg: DisaggConfig = dataclasses.field(
+        default_factory=DisaggConfig
     )

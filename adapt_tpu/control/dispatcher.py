@@ -755,7 +755,13 @@ class Dispatcher:
         ``src/dispatcher.py:178``). Preference: already-configured idle >
         idle > shallowest queue; excluded (suspect) workers only as a last
         resort."""
-        alive = set(self.registry.alive())
+        # Role-tagged leases partition the pool: a worker registered
+        # under a dedicated role (the disaggregated serving tier's
+        # role="prefill" pool, runtime/disagg) must never be acquired
+        # for pipeline stages — its capacity is spoken for. Untagged
+        # leases (every pre-role registration) stay fully schedulable.
+        # One registry lock hold (alive_untagged), not one per worker.
+        alive = set(self.registry.alive_untagged())
         with self._workers_lock:
             pool = [
                 w

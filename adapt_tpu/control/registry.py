@@ -106,10 +106,40 @@ class WorkerRegistry:
 
     # -- dispatcher API (reference: _get_available_workers / _worker_monitor)
 
-    def alive(self) -> list[str]:
+    def alive(self, role: str | None = None) -> list[str]:
+        """Live lease holders. ``role`` filters by the lease's
+        ``meta["role"]`` tag — the worker-pool partitioning knob the
+        disaggregated serving tier uses (``runtime/disagg`` registers
+        its prefill pool under ``role="prefill"``, so the pipeline
+        dispatcher's acquisition and the placement policy read disjoint
+        pools off ONE membership registry). ``role=None`` returns
+        every live lease, tagged or not (the pre-role behavior)."""
         now = time.monotonic()
         with self._lock:
-            return [w for w, exp in self._leases.items() if exp > now]
+            return [
+                w
+                for w, exp in self._leases.items()
+                if exp > now
+                and (role is None or self._meta[w].get("role") == role)
+            ]
+
+    def alive_untagged(self) -> list[str]:
+        """Live leases with NO role tag — the pool general schedulers
+        (the pipeline dispatcher's ``_acquire``) may draw from. One
+        lock hold, unlike filtering ``alive()`` through per-worker
+        :meth:`role` calls on the dispatch hot path."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                w
+                for w, exp in self._leases.items()
+                if exp > now and self._meta[w].get("role") is None
+            ]
+
+    def role(self, worker_id: str) -> str | None:
+        """The lease's ``meta["role"]`` tag (None = untagged)."""
+        with self._lock:
+            return self._meta.get(worker_id, {}).get("role")
 
     def meta(self, worker_id: str) -> dict:
         with self._lock:

@@ -327,6 +327,75 @@ class KVReshardPlan:
         }
 
 
+@dataclasses.dataclass
+class KVHandoffPlan:
+    """Placement plan for KV pages STREAMED INTO a serving pool from
+    outside the mesh — the disaggregated prefill/decode handoff
+    (``runtime/disagg``), sibling of :class:`KVReshardPlan` (that one
+    moves live state ACROSS a mesh shrink; this one lands host-staged
+    pages on whatever layout the destination pool runs).
+
+    The pages arrive host-side as page-major ``(n_pages, kv_heads,
+    page, w)`` arrays holding the FULL head range (the prefill tier is
+    layout-agnostic by design — it need not know the decode mesh). The
+    plan maps them onto the pool's sharding by ALIGNED UNION, never a
+    global gather (the 2211.05322 cross-mesh point-to-point
+    discipline): under a head-sharded decode pool each shard's head
+    range is a contiguous slice of the incoming array, so every device
+    receives ONLY its own heads — one host->device transfer of
+    ``logical_bytes / tp`` per shard, no replicated staging, no
+    all-gather for GSPMD to untangle. Single-device and no-mesh pools
+    degrade to one ordinary placement. Both members of a quantized
+    ``(values, scales)`` pool place under the same plan
+    (:meth:`place_tree`), so a page's scales land with its int8
+    payload."""
+
+    #: The destination pool's sharding: a head-axis ``NamedSharding``
+    #: (``kv_head_sharding``), a ``SingleDeviceSharding`` (tp=1
+    #: remnant), or None (no-mesh pool — default placement). The shard
+    #: slices are read straight off ``devices_indices_map``, so any
+    #: axis layout the sharding expresses is honored as-is.
+    sharding: object | None
+    #: Bytes staged host->device by this plan (sums to the logical
+    #: bytes once per placed tree — each shard stages only its slice).
+    staged_bytes: int = 0
+
+    def place(self, kv_host):
+        """Place ONE page-major host array onto the pool's layout.
+        Returns a jax array whose sharding matches the pool's, built
+        shard-by-shard — the scatter into the pool is then fully
+        shard-local (no collective in the adoption program)."""
+        kv_host = np.asarray(kv_host)
+        self.staged_bytes += int(kv_host.nbytes)
+        if self.sharding is None:
+            return jnp.asarray(kv_host)
+        if not isinstance(self.sharding, NamedSharding):
+            # SingleDeviceSharding (and duck-typed equivalents): one
+            # committed placement, same discipline as the tp=1 remnant.
+            return jax.device_put(kv_host, self.sharding)
+        shape = kv_host.shape
+        bufs = [
+            # Basic slicing: each shard's slice is a VIEW of the host
+            # array; the only copy is the transfer itself.
+            jax.device_put(kv_host[idx], d)
+            for d, idx in self.sharding.devices_indices_map(shape).items()
+        ]
+        return jax.make_array_from_single_device_arrays(
+            shape, self.sharding, bufs
+        )
+
+    def place_tree(self, tree):
+        """:meth:`place` over every leaf — the ``(values, scales)``
+        members of quantized page chunks land under ONE plan."""
+        return jax.tree.map(self.place, tree)
+
+
+def plan_kv_handoff(sharding) -> KVHandoffPlan:
+    """Build the :class:`KVHandoffPlan` for a destination pool's
+    sharding (None for a no-mesh pool)."""
+    return KVHandoffPlan(sharding=sharding)
+
+
 def plan_kv_reshard(
     old_devices, new_devices, lost_ids, axis: str = "tp"
 ) -> KVReshardPlan:

@@ -1,12 +1,15 @@
 from adapt_tpu.runtime.continuous import ContinuousBatcher
 from adapt_tpu.runtime.decode_pipeline import PipelinedDecoder
+from adapt_tpu.runtime.disagg import DisaggServer, PrefillWorker
 from adapt_tpu.runtime.paged import Pager
 from adapt_tpu.runtime.pipeline import LocalPipeline, ServingPipeline
 
 __all__ = [
     "ContinuousBatcher",
+    "DisaggServer",
     "LocalPipeline",
     "Pager",
     "PipelinedDecoder",
+    "PrefillWorker",
     "ServingPipeline",
 ]

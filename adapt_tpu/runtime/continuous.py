@@ -263,6 +263,7 @@ from adapt_tpu.ops.quantize import dequantize_params, quantize_params
 from adapt_tpu.parallel.sharding import (
     kv_head_sharding,
     lm_tp_rules,
+    plan_kv_handoff,
     plan_kv_reshard,
     tree_shardings,
 )
@@ -789,6 +790,14 @@ class ContinuousBatcher:
         self._admitted = 0
         self._completed = 0
         self._ticks = 0
+        #: Prompt tokens THIS batcher prefilled in-tick (full
+        #: admissions, suffix passes, chunk passes — positions actually
+        #: computed, prefix-cache hits excluded). Mirrored as the
+        #: ``continuous.prefill_tokens_total`` counter so benches can
+        #: report prefill-tokens/s and decode-tokens/s separately —
+        #: the ratio disaggregation moves (handed-off requests prefill
+        #: in the prefill tier, so only their suffix lands here).
+        self._prefill_tokens = 0
         #: Request-timeline SLO histograms (queue-wait / TTFT /
         #: inter-token-latency / request latency). ON by default — the
         #: hot-path cost is one perf_counter stamp per committed token
@@ -843,6 +852,12 @@ class ContinuousBatcher:
             "continuous.clear_slot", type(self)._clear_slot
         )
         self._sentinel.register("continuous.insert", type(self)._insert)
+        if self._paged:
+            # Disaggregated-handoff landing program (adopt_prefill_pages
+            # — dispatched only when a prefill tier streams pages in).
+            self._sentinel.register(
+                "continuous.adopt_pages", type(self)._adopt_pages
+            )
         if self._spec:
             self._sentinel.register(
                 "continuous.spec_verify", type(self)._spec_verify
@@ -1283,6 +1298,174 @@ class ContinuousBatcher:
             self._repl_state(new),
         )
 
+    @partial(
+        jax.jit,
+        static_argnums=(0,),
+        static_argnames=("epoch",),
+        donate_argnums=(1,),
+    )
+    def _adopt_pages(self, caches, pages, kvs, *, epoch=0):
+        """Scatter STREAMED page-major KV chunks into the pool — the
+        disaggregated-handoff landing program (``runtime/disagg`` ->
+        :meth:`adopt_prefill_pages`). ``pages`` (nb,) physical page
+        ids (power-of-two padded; pad entries point at the trash
+        page), ``kvs`` mirrors ``caches``' per-block (K, V) structure
+        with leaves ``(nb, kvh, page, w)`` already PLACED to the
+        pool's sharding by the ``KVHandoffPlan`` — so under a
+        head-sharded mesh this scatter is fully shard-local (each
+        device writes only its resident heads; no collective, no
+        replicated staging). One program for all blocks; specializes
+        per page-count bucket (log2 variants)."""
+        caches = self._shard_kv(caches)
+        kvs = self._shard_kv(kvs)
+        out = [
+            jax.tree.map(
+                lambda pool, kv: pool.at[pages].set(kv.astype(pool.dtype)),
+                c_pair,
+                n_pair,
+            )
+            for c_pair, n_pair in zip(caches, kvs)
+        ]
+        return self._shard_kv(out)
+
+    def adopt_prefill_pages(self, prompt, blocks, page_size: int,
+                            quantized: bool) -> int:
+        """Land a disaggregated prefill's KV pages in this batcher's
+        pool THROUGH THE PREFIX CACHE — the decode-side half of the
+        ``runtime/disagg`` handoff. ``blocks`` is one ``(K, V)`` pair
+        per decoder block, each member a page-major ``(n, kvh, page,
+        hd)`` host array (or a ``(values, scales)`` tuple of them for
+        int8 pools), holding the K/V of ``prompt``'s first ``n`` FULL
+        pages exactly as this batcher's own chunked prefill would have
+        written them.
+
+        Pages register under the same content keys the admission
+        prefix probe computes (``Pager.prefix_key``), park rc=0 in the
+        prefix LRU, and their bytes scatter in via :meth:`_adopt_pages`
+        — so a subsequent :meth:`submit` of the same prompt admits as
+        a PREFIX-CACHE HIT and prefills only the suffix (the partial
+        last page + first-token sampling). That reuse of the existing
+        insertion path is what makes int8 pools (both members move
+        under one :class:`~adapt_tpu.parallel.sharding.KVHandoffPlan`)
+        and speculative mode (the draft prefills decode-side as
+        always) compose with disaggregation for free, and keeps greedy
+        streams bit-identical to the collocated path.
+
+        Returns the number of pages actually adopted: already-resident
+        keys dedupe (first writer won), and pool pressure adopts
+        NOTHING (all-or-nothing, like admission) — the caller just
+        submits and the request collocates its own prefill. Raises
+        ``ValueError`` on geometry mismatches (layout, page size,
+        quantization, block count/shapes) — a malformed handoff must
+        fail by name, never scatter garbage into live pages."""
+        # The device-lost gate tick() runs: a handoff landing between
+        # ticks must not device_put shard slices onto a dead device or
+        # dispatch the adoption program at a stale mesh epoch (the
+        # disaggregated server lands handoffs BEFORE its decode tick).
+        self._ensure_mesh()
+        if not self._paged:
+            raise ValueError(
+                "adopt_prefill_pages requires kv_layout='paged' (the "
+                "handoff lands through the paged prefix cache)"
+            )
+        if page_size != self._page:
+            raise ValueError(
+                f"handoff page size {page_size} != pool page size "
+                f"{self._page}"
+            )
+        if quantized != self._kv_quant:
+            raise ValueError(
+                f"handoff quantized={quantized} but pool "
+                f"kv_cache_dtype is "
+                f"{'int8' if self._kv_quant else 'native'}"
+            )
+        if len(blocks) != len(self._blocks):
+            raise ValueError(
+                f"handoff has {len(blocks)} blocks, model has "
+                f"{len(self._blocks)}"
+            )
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        k0 = blocks[0][0]
+        leaf0 = k0[0] if isinstance(k0, tuple) else k0
+        n = int(leaf0.shape[0])
+        if n < 1 or n > (prompt.shape[0] - 1) // self._page:
+            raise ValueError(
+                f"handoff covers {n} pages; prompt of "
+                f"{prompt.shape[0]} tokens shares at most "
+                f"{(prompt.shape[0] - 1) // self._page} full pages"
+            )
+        # EVERY block's geometry validates BEFORE any pager mutation:
+        # adopt_cached registers prefix keys, and raising after it
+        # would leave content keys pointing at never-written pages —
+        # the next same-prefix admission would prefix-hit garbage.
+        for b, (block, pair) in enumerate(zip(self._blocks, blocks)):
+            for mname, member in zip(("K", "V"), pair):
+                if isinstance(member, tuple) != self._kv_quant:
+                    raise ValueError(
+                        f"handoff block {b} {mname}: "
+                        f"{'tuple' if isinstance(member, tuple) else 'array'}"
+                        f" member in a "
+                        f"{'quantized' if self._kv_quant else 'native'}"
+                        " pool"
+                    )
+                leaves = member if isinstance(member, tuple) else (member,)
+                for li, leaf in enumerate(leaves):
+                    width = block.head_dim if li == 0 else 1
+                    want = (n, block.cache_heads, self._page, width)
+                    if tuple(np.shape(leaf)) != want:
+                        raise ValueError(
+                            f"handoff block {b} {mname}[{li}] shape "
+                            f"{tuple(np.shape(leaf))} != expected {want}"
+                        )
+        keys = [
+            Pager.prefix_key(prompt, (j + 1) * self._page)
+            for j in range(n)
+        ]
+        adopted = self._pager.adopt_cached(keys)
+        if not adopted:
+            return 0
+        ords = [i for i, _ in adopted]
+        pages = [p for _, p in adopted]
+        na = len(ords)
+        nb = 1
+        while nb < na:
+            nb *= 2
+
+        def select(kv):
+            kv = np.asarray(kv)
+            if na == nb and na == n:
+                return kv  # common case: everything fresh, no copy
+            out = np.zeros((nb,) + kv.shape[1:], kv.dtype)
+            out[:na] = kv[ords]
+            return out
+
+        plan = plan_kv_handoff(
+            self._kv_sharding if self._mesh is not None else self._repl
+        )
+        placed = [
+            jax.tree.map(select, pair) for pair in blocks
+        ]
+        placed = [plan.place_tree(pair) for pair in placed]
+        # Transfer accounting: one logical staging per placed leaf plus
+        # the page-id vector (the same O(1)-per-event contract as
+        # admission staging; steady ticks stay at zero), and the
+        # plan's host->device byte count as a counter — per-shard
+        # slices sum to the logical bytes, i.e. logical/tp per device.
+        self._h2d_count += sum(
+            len(jax.tree.leaves(pair)) for pair in placed
+        )
+        global_metrics().inc(
+            "disagg.adopt_staged_bytes", float(plan.staged_bytes)
+        )
+        pages_dev = self._h2d(
+            np.asarray(pages + [0] * (nb - na), np.int32)
+        )
+        self._variants.setdefault("continuous.adopt_pages", set()).add(nb)
+        self._caches = self._adopt_pages(
+            self._caches, pages_dev, placed, epoch=self._mesh_epoch
+        )
+        return na
+
     def _insert_paged(self, caches, pages, kvs):
         """Scatter a prefilled request's per-block K/V into its pages
         (``runtime/paged.insert_prefill_pages`` per pool). tree.map
@@ -1476,42 +1659,25 @@ class ContinuousBatcher:
 
     # -- request lifecycle -------------------------------------------------
 
-    def submit(
+    def validate_request(
         self,
         prompt,
         steps: int,
         temperature: float = 0.0,
         top_k: int | None = None,
         top_p: float | None = None,
-        eos_id: int | None = None,
-        rng: jax.Array | None = None,
+        rng=None,
         stop: list | None = None,
-        on_token: Callable[[int, int, int], None] | None = None,
         slo: SLOSpec | None = None,
-    ) -> int:
-        """Queue one request; returns its id. ``slo`` (optional
-        ``config.SLOSpec``) attaches a latency budget: TTFT is judged
-        once at the first emitted token, ITL at every later commit,
-        feeding the ``slo.*`` attainment metrics, the per-tenant
-        met/missed counters and ``continuous.goodput_tokens_s``
-        (evaluation rides the ``obs_timeline`` gate — host arithmetic
-        on stamps already taken, nothing device-side).
-        ``on_token`` (optional
-        ``callable(req_id, token, index)``) streams each committed
-        token as it lands — invoked on the TICKING thread at commit
-        time (chunk granularity: up to ``chunk`` callbacks per tick),
-        so keep it cheap and thread-safe. Exceptions poison the tick:
-        synchronous drivers see them directly; under :meth:`start` the
-        server stops and every ``result()`` waiter re-raises the
-        callback's exception (never a silent timeout).
-        ``stop`` is a list of
-        token-id sequences: the stream ends at the first emitted
-        occurrence of any of them, stop tokens included — host-side
-        truncation, so the emitted prefix still equals solo
-        ``generate()``. ``prompt`` is a 1-D token
-        id sequence; ``top_k`` overrides the batcher default for this
-        request. The sampling-key schedule matches ``generate`` for a
-        solo batch, so outputs are reproducible against it."""
+    ) -> tuple[np.ndarray, int | None]:
+        """Raise exactly the errors :meth:`submit` would for these
+        arguments, without queueing anything — THE one validation
+        body. The disaggregated submit path (``runtime/disagg``) calls
+        it up front so a bad request fails synchronously like a
+        collocated one, instead of minutes later at handoff landing —
+        and a future rule added here automatically covers both paths.
+        Returns the normalized ``(int32, 1-D)`` prompt and the
+        effective ``top_k`` (request's, or the batcher default)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         s0 = prompt.shape[0]
         if s0 < 1:
@@ -1544,11 +1710,8 @@ class ContinuousBatcher:
                     f"request needs {need} pages but the pool holds "
                     f"{self._pool_pages - 1} allocatable"
                 )
-        do_sample = temperature > 0.0
-        if do_sample and rng is None:
+        if temperature > 0.0 and rng is None:
             raise ValueError("temperature > 0 requires an rng key")
-        if rng is None:
-            rng = jax.random.PRNGKey(0)
         top_k_eff = top_k if top_k is not None else self.top_k
         if top_k_eff is not None and not (1 <= top_k_eff <= self.lm.vocab):
             raise ValueError(
@@ -1562,6 +1725,59 @@ class ContinuousBatcher:
             raise TypeError(
                 f"slo must be a config.SLOSpec, got {type(slo).__name__}"
             )
+        return prompt, top_k_eff
+
+    def submit(
+        self,
+        prompt,
+        steps: int,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        eos_id: int | None = None,
+        rng: jax.Array | None = None,
+        stop: list | None = None,
+        on_token: Callable[[int, int, int], None] | None = None,
+        slo: SLOSpec | None = None,
+        t_submit: float | None = None,
+    ) -> int:
+        """Queue one request; returns its id. ``slo`` (optional
+        ``config.SLOSpec``) attaches a latency budget: TTFT is judged
+        once at the first emitted token, ITL at every later commit,
+        feeding the ``slo.*`` attainment metrics, the per-tenant
+        met/missed counters and ``continuous.goodput_tokens_s``
+        (evaluation rides the ``obs_timeline`` gate — host arithmetic
+        on stamps already taken, nothing device-side).
+        ``on_token`` (optional
+        ``callable(req_id, token, index)``) streams each committed
+        token as it lands — invoked on the TICKING thread at commit
+        time (chunk granularity: up to ``chunk`` callbacks per tick),
+        so keep it cheap and thread-safe. Exceptions poison the tick:
+        synchronous drivers see them directly; under :meth:`start` the
+        server stops and every ``result()`` waiter re-raises the
+        callback's exception (never a silent timeout).
+        ``stop`` is a list of
+        token-id sequences: the stream ends at the first emitted
+        occurrence of any of them, stop tokens included — host-side
+        truncation, so the emitted prefix still equals solo
+        ``generate()``. ``prompt`` is a 1-D token
+        id sequence; ``top_k`` overrides the batcher default for this
+        request. The sampling-key schedule matches ``generate`` for a
+        solo batch, so outputs are reproducible against it.
+        ``t_submit`` (perf-counter clock) overrides the lifecycle
+        anchor for requests that entered the SERVING SYSTEM earlier
+        than this call — the disaggregated submit path
+        (``runtime/disagg``) passes the server-level arrival stamp so
+        queue-wait/TTFT/SLO verdicts stay end-to-end honest instead of
+        starting the clock after the prefill tier already ran."""
+        prompt, top_k_eff = self.validate_request(
+            prompt, steps, temperature=temperature, top_k=top_k,
+            top_p=top_p, rng=rng, stop=stop, slo=slo,
+        )
+        s0 = prompt.shape[0]
+        do_sample = temperature > 0.0
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
         if do_sample:
             # generate()'s exact schedule: split -> key0 + per-step
             # keys, each folded with the row index (0 — solo
@@ -1616,7 +1832,9 @@ class ContinuousBatcher:
                 tuple(int(t) for t in seq) for seq in (stop or ())
             ),
             on_token=on_token,
-            t_submit=time.perf_counter(),
+            t_submit=(
+                t_submit if t_submit is not None else time.perf_counter()
+            ),
             slo=slo,
         )
         if self._journal is not None:
@@ -1933,6 +2151,13 @@ class ContinuousBatcher:
             # inserts via _insert_paged and must not bank an allowance
             # that would mask a later real phantom variant.
             expected["continuous.insert"] = nvar("continuous.insert")
+        if self._paged:
+            # Handoff-adoption variants re-lower like every other
+            # sharding-constrained program (nvar rule: only buckets
+            # actually dispatched under the old epoch).
+            expected["continuous.adopt_pages"] = nvar(
+                "continuous.adopt_pages"
+            )
         if self._spec:
             expected["continuous.spec_verify"] = 1
             expected["speculative.draft_chunk"] = 1
@@ -2540,6 +2765,7 @@ class ContinuousBatcher:
                     truncate=req.top_k < self.lm.vocab,
                     nucleus=req.top_p < 1.0,
                 )
+                self._count_prefill(slen)
             else:
                 ids = np.zeros((1, bucket), np.int32)
                 ids[0, :s0] = req.prompt
@@ -2570,6 +2796,7 @@ class ContinuousBatcher:
                     self._caches = self._insert(
                         self._caches, self._h2d(np.int32(i)), kvs
                     )
+                self._count_prefill(s0)
             if self._paged and not chunked:
                 # Publish this request's full prompt pages for future
                 # sharing (first writer wins; the shared ones are
@@ -2681,6 +2908,36 @@ class ContinuousBatcher:
             epoch=self._mesh_epoch,
         )
 
+    def _ensure_mesh(self) -> None:
+        """The device-lost gate, shared by every dispatch ENTRY POINT
+        running on the ticking thread (``tick``,
+        :meth:`adopt_prefill_pages`): a mesh device died since the
+        last pass — recover BEFORE dispatching anything onto the
+        broken layout. Under ``auto_reshard`` this re-shards inline
+        and proceeds on the shrunk mesh; otherwise every dispatch
+        raises until :meth:`recover` is called."""
+        if self._lost_pending:
+            if self._recovery.auto_reshard:
+                self.recover()
+            else:
+                with self._cv:
+                    lost = list(self._lost_pending)
+                raise DeviceLostError(
+                    f"mesh device(s) lost: {lost} — auto_reshard is "
+                    "off; call recover()"
+                )
+
+    def _count_prefill(self, n: int) -> None:
+        """Book ``n`` prompt positions computed by an in-tick prefill
+        pass (instance counter always; the registry counter rides the
+        ``obs_timeline`` gate like every other timeline counter — one
+        inc per pass, admission-rate, not token-rate)."""
+        self._prefill_tokens += n
+        if self.obs_timeline:
+            global_metrics().inc(
+                "continuous.prefill_tokens_total", float(n)
+            )
+
     def _current_table(self):
         """Device copy of the pager's page table, re-uploaded only when
         the host table changed (admissions, retirements, window
@@ -2738,6 +2995,7 @@ class ContinuousBatcher:
             nucleus=final and req.top_p < 1.0,
         )
         slot.pf_done = pos0 + clen
+        self._count_prefill(clen)
         if tracer.enabled:
             tracer.add_span(
                 "batcher.prefill_chunk",
@@ -2875,25 +3133,24 @@ class ContinuousBatcher:
         costs one branch. The compile sentinel samples once at the end
         of every tick, so an unexpected recompile is flagged next to
         the tick that paid for it."""
-        if self._lost_pending:
-            # A mesh device died since the last tick: recover BEFORE
-            # dispatching anything onto the broken layout. Under
-            # auto_reshard the tick re-shards inline and proceeds on
-            # the shrunk mesh; otherwise every dispatch raises until
-            # recover() is called.
-            if self._recovery.auto_reshard:
-                self.recover()
-            else:
-                with self._cv:
-                    lost = list(self._lost_pending)
-                raise DeviceLostError(
-                    f"mesh device(s) lost: {lost} — auto_reshard is "
-                    "off; call recover()"
-                )
+        self._ensure_mesh()
         eo = self._eobs
         # Snapshot the gate ONCE per tick (see _spec_decode).
         eo_on = eo.enabled
         t_ph = eo.now() if eo_on else 0.0
+        # Prefill-stall accounting (continuous.prefill_stall_s): when
+        # requests were already DECODING at tick entry, every second
+        # this tick spends on in-tick prefill work (admission prefill
+        # passes, chunked-prefill passes) is decode delay they eat as
+        # inter-token latency — the pathology the disaggregated path
+        # (runtime/disagg) exists to remove. Two stamps + one counter
+        # delta per tick; observed only when prefill actually ran.
+        obs_on = self.obs_timeline
+        decode_waiting = obs_on and any(
+            s.req is not None and s.pf_done < 0 for s in self.slots
+        )
+        t_stall0 = time.perf_counter() if decode_waiting else 0.0
+        pf_tokens0 = self._prefill_tokens
         self._admit()
         if eo_on:
             t_ph = eo.phase("admit", t_ph)
@@ -2908,6 +3165,11 @@ class ContinuousBatcher:
         for slot in self.slots:
             if slot.req is not None and slot.pf_done >= 0:
                 self._prefill_step(slot)  # interleaves with decode below
+        if decode_waiting and self._prefill_tokens > pf_tokens0:
+            global_metrics().observe(
+                "continuous.prefill_stall_s",
+                time.perf_counter() - t_stall0,
+            )
         if eo_on:
             eo.phase("prefill", t_ph)
         active = [
@@ -3060,6 +3322,12 @@ class ContinuousBatcher:
                 "admitted": self._admitted,
                 "completed": self._completed,
                 "ticks": self._ticks,
+                # Prompt positions prefilled IN-TICK by this batcher
+                # (full/suffix/chunk passes; prefix-cache hits and
+                # disaggregated handoffs excluded) — pair with the
+                # committed-token counters for a prefill/decode
+                # tokens-per-second split.
+                "prefill_tokens": self._prefill_tokens,
                 # Host->device staging transfers this batcher issued
                 # (every jnp.asarray in this module funnels through
                 # _h2d): the fused-staging contract is ZERO per

@@ -226,6 +226,35 @@ class Pager:
         self.prefix_hits += 1
         return page
 
+    def adopt_cached(self, keys: list[bytes]) -> list[tuple[int, int]]:
+        """Adopt EXTERNALLY prefilled prefix pages into the cache — the
+        disaggregated-serving landing path (``runtime/disagg``): for
+        every key not already resident, take a pool page, register it
+        under its content key and park it rc=0 in the LRU (newest), so
+        the next admission whose prompt hashes to these keys shares
+        them exactly like locally computed prefix pages (evictable
+        under pressure by the usual rules until then). Returns
+        ``[(ordinal, page)]`` for the keys actually adopted — the
+        caller scatters ONLY those ordinals' K/V (already-resident keys
+        dedupe against the cache; first writer won). Returns ``[]``
+        with nothing taken when the pool cannot cover the new pages
+        all-or-nothing (the caller falls back to a collocated
+        prefill — adoption is an optimization, never a correctness
+        gate)."""
+        fresh = [
+            (i, k) for i, k in enumerate(keys) if k not in self._by_key
+        ]
+        if not fresh or not self.can_alloc(len(fresh)):
+            return []
+        out = []
+        for i, key in fresh:
+            page = self._take_one()
+            self._by_key[key] = page
+            self._key_of[page] = key
+            self._lru[page] = None  # rc=0, resident, newest
+            out.append((i, page))
+        return out
+
     def register(self, page: int, key: bytes) -> None:
         """Publish ``page`` (currently owned, rc>=1) as the cache entry
         for ``key``. First writer wins; a page may carry one key."""

@@ -103,12 +103,18 @@ def _child(slots: int, n_requests: int, small: bool, chunk: int,
     # region, mirroring generate()'s warmup below.
     bat.submit(prompts[0], 2)
     bat.run()  # drains the warmup request; timed run starts empty
+    prefill_tokens0 = bat.stats()["prefill_tokens"]
     t0 = time.perf_counter()
     for p, s in zip(prompts, steps):
         bat.submit(p, s)
     done = bat.run()
     cont_s = time.perf_counter() - t0
     assert len(done) == n_requests
+    # Prefill/decode split: the headline tokens/sec blends decode
+    # tokens over a wall that includes prefill work — these two fields
+    # separate the rates (exactly the ratio disaggregated serving
+    # changes; see docs/SERVING.md "Disaggregated prefill/decode").
+    prefill_tokens = bat.stats()["prefill_tokens"] - prefill_tokens0
 
     # -- batch-synchronous rounds ---------------------------------------
     batch0 = jnp.stack([jnp.asarray(p) for p in prompts[:slots]])
@@ -145,6 +151,12 @@ def _child(slots: int, n_requests: int, small: bool, chunk: int,
                 "step_mix": list(STEP_MIX),
                 "continuous_s": round(cont_s, 3),
                 "batch_sync_s": round(sync_s, 3),
+                "prefill_tokens_per_sec": round(
+                    prefill_tokens / cont_s, 2
+                ),
+                "decode_tokens_per_sec": round(
+                    total_tokens / cont_s, 2
+                ),
             }
         ),
         flush=True,

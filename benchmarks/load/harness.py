@@ -83,6 +83,52 @@ def warmup(bat, vocab: int, steps_max: int, prompt_max: int) -> None:
     bat.run()
 
 
+def warmup_disagg(srv, vocab: int, steps_max: int,
+                  prompt_max: int) -> None:
+    """Disaggregated-server warmup: the shared :func:`warmup` pass with
+    placement forced COLLOCATED (decode-side prefill buckets + key
+    blocks), then one disagg-path admission per reachable full-page
+    count — the prefill worker's chunk programs, the adopt-pages
+    buckets and the decode side's per-page-count suffix variants all
+    compile here instead of as fake mid-phase stalls."""
+    import numpy as np
+
+    from adapt_tpu.config import DisaggConfig
+
+    real = srv.cfg
+    srv.cfg = DisaggConfig(
+        prompt_threshold=10**6, busy_prompt_threshold=10**6
+    )
+    try:
+        warmup(srv, vocab, steps_max, prompt_max)
+    finally:
+        srv.cfg = real
+    P = srv.decode._page
+    thr = min(real.prompt_threshold, real.busy_prompt_threshold)
+    m_lo = max(1, (thr - 1) // P)
+    m_hi = (prompt_max - 1) // P
+    rng = np.random.RandomState(1)
+    # Pin BOTH thresholds to the lower (busy) one for the warmup loop:
+    # warmup runs at zero occupancy, where the real config would apply
+    # only prompt_threshold and silently collocate the busy-tier
+    # lengths — leaving their adopt/suffix variants to compile
+    # mid-phase, the exact fake stall this function exists to prevent.
+    srv.cfg = DisaggConfig(prompt_threshold=thr, busy_prompt_threshold=thr)
+    try:
+        for m in range(m_lo, m_hi + 1):
+            # Smallest prompt with m full pages the policy will
+            # actually disaggregate (at least the threshold).
+            s0 = min(max(m * P + 1, thr), prompt_max)
+            if (s0 - 1) // P != m:
+                continue
+            srv.submit(
+                rng.randint(0, vocab, size=s0).astype(np.int32), 2
+            )
+        srv.run()
+    finally:
+        srv.cfg = real
+
+
 def drive_phase(
     bat,
     schedule: list[Arrival],
@@ -190,6 +236,22 @@ def drive_phase(
              "engine.bytes_accessed")
         )
     }
+    # Prefill/decode token-rate SPLIT: one blended tokens/s hides
+    # exactly the ratio disaggregation changes, so report prompt
+    # positions prefilled per second (decode-tick prefill work plus
+    # any prefill-tier work) next to committed decode tokens per
+    # second. The stall histogram is the decode-delay the in-tick
+    # share of that prefill work caused.
+    prefill_tokens = c.get("continuous.prefill_tokens_total", 0.0) + c.get(
+        "disagg.prefill_tokens_total", 0.0
+    )
+    stall = delta["histograms"].get("continuous.prefill_stall_s", {})
+    # decode_tokens_s IS throughput_tokens_s today (committed decode
+    # tokens over the window); both keys ship so the prefill/decode
+    # split reads naturally next to prefill_tokens_s, computed once.
+    decode_tokens_s = round(
+        c.get("continuous.tokens_total", 0.0) / window_s, 2
+    )
     return {
         "requests": n,
         "offered_rps": round(n / spec.duration_s, 4),
@@ -199,9 +261,14 @@ def drive_phase(
         "goodput_tokens_s": round(
             c.get("continuous.good_tokens_total", 0.0) / window_s, 2
         ),
-        "throughput_tokens_s": round(
-            c.get("continuous.tokens_total", 0.0) / window_s, 2
-        ),
+        "throughput_tokens_s": decode_tokens_s,
+        "decode_tokens_s": decode_tokens_s,
+        "prefill_tokens_s": round(prefill_tokens / window_s, 2),
+        "prefill_stall_s": {
+            k: round(stall[k], 6)
+            for k in ("p50", "p99", "max", "sum", "count")
+            if k in stall
+        },
         "slo_attainment": (
             round(req_met / (req_met + req_missed), 4)
             if req_met + req_missed
@@ -251,6 +318,7 @@ def build_batcher(
     slots: int,
     chunk: int,
     layout: str = "slots",
+    page_size: int = 128,
 ):
     """The harness's model+batcher factory (CPU-forced; tiny LM — the
     harness measures the serving tier's behavior under load, not model
@@ -267,9 +335,54 @@ def build_batcher(
     variables = lm.graph.init(
         jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
     )
+    kw = {"page_size": page_size} if layout == "paged" else {}
     return ContinuousBatcher(
-        lm, variables, slots=slots, chunk=chunk, kv_layout=layout
+        lm, variables, slots=slots, chunk=chunk, kv_layout=layout, **kw
     )
+
+
+def build_disagg(
+    vocab: int,
+    max_len: int,
+    slots: int,
+    chunk: int,
+    page_size: int = 16,
+    prefill_chunk: int | None = None,
+    prompt_threshold: int = 48,
+    busy_prompt_threshold: int | None = None,
+):
+    """The disaggregated counterpart of :func:`build_batcher`: a paged
+    decode batcher, a chunked ``PrefillWorker`` and the
+    ``DisaggServer`` placement policy in front — same driver surface,
+    so ``drive_phase``/``run_sweep`` run the SAME schedule through
+    either placement for an apples-to-apples curve. ``prefill_chunk``
+    defaults to two pages (the per-tick stall bound)."""
+    decode = build_batcher(
+        vocab, max_len, slots, chunk, layout="paged",
+        page_size=page_size,
+    )
+    from adapt_tpu.config import DisaggConfig
+    from adapt_tpu.runtime.disagg import DisaggServer, PrefillWorker
+
+    worker = PrefillWorker(
+        decode.lm,
+        decode.variables,
+        page_size=page_size,
+        prefill_chunk=prefill_chunk or 2 * page_size,
+    )
+    # Default busy threshold: two pages, capped at the main threshold.
+    # A/B drivers pass busy == prompt_threshold instead, which makes
+    # the placement a PURE function of the schedule (occupancy plays
+    # no role) — run-to-run comparable.
+    cfg = DisaggConfig(
+        prompt_threshold=prompt_threshold,
+        busy_prompt_threshold=(
+            busy_prompt_threshold
+            if busy_prompt_threshold is not None
+            else min(prompt_threshold, 2 * page_size)
+        ),
+    )
+    return DisaggServer(decode, worker, cfg)
 
 
 def main() -> int:
@@ -282,26 +395,57 @@ def main() -> int:
     layout = str_flag(
         sys.argv, "--layout", "slots", choices=("slots", "paged")
     )
+    preset_name = str_flag(sys.argv, "--preset", "")
+    placement = str_flag(
+        sys.argv, "--placement", "collocated",
+        choices=("collocated", "disagg"),
+    )
     out = str_flag(sys.argv, "--out", "")
     try:
         rates = [float(r) for r in rates_arg.split(",") if r]
-        spec = WorkloadSpec(
-            duration_s=float(duration),
-            cancel_fraction=cancel_pct / 100.0,
-        )
+        if preset_name:
+            from benchmarks.load.workload import preset
+
+            spec = preset(
+                preset_name,
+                duration_s=float(duration),
+                cancel_fraction=cancel_pct / 100.0,
+            )
+        else:
+            spec = WorkloadSpec(
+                duration_s=float(duration),
+                cancel_fraction=cancel_pct / 100.0,
+            )
         from adapt_tpu.utils.profiling import global_engine_obs
 
-        bat = build_batcher(
-            spec.vocab,
-            spec.prompt_max + spec.steps_max + 8,
-            slots,
-            chunk,
-            layout,
-        )
+        if placement == "disagg":
+            # Same schedule, disaggregated serving path (paged decode +
+            # prefill tier) — the apples-to-apples arm of the
+            # long-tail-prefill comparison (see load/disagg_smoke.py).
+            bat = build_disagg(
+                spec.vocab,
+                spec.prompt_max + spec.steps_max + 8,
+                slots,
+                chunk,
+            )
+        else:
+            bat = build_batcher(
+                spec.vocab,
+                spec.prompt_max + spec.steps_max + 8,
+                slots,
+                chunk,
+                layout,
+            )
         # Phase timing on: every curve point gets its roofline
         # annotation (mbu/mfu need measured phase walls).
         global_engine_obs().enabled = True
-        warmup(bat, spec.vocab, spec.steps_max, spec.prompt_max)
+        if placement == "disagg":
+            # The disagg-aware warmup: prefill-worker chunk programs,
+            # adopt-pages buckets and per-page-count suffix variants
+            # must compile here, not as fake mid-phase stalls.
+            warmup_disagg(bat, spec.vocab, spec.steps_max, spec.prompt_max)
+        else:
+            warmup(bat, spec.vocab, spec.steps_max, spec.prompt_max)
         points = run_sweep(bat, spec, rates, seed)
         peak = max(p["goodput_tokens_s"] for p in points)
         report = {
@@ -314,6 +458,8 @@ def main() -> int:
             "slots": slots,
             "chunk": chunk,
             "layout": layout,
+            "placement": placement,
+            "preset": preset_name or None,
             "spec": dataclasses.asdict(spec),
             "points": [
                 {k: v for k, v in p.items() if k != "token_counts"}

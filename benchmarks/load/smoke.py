@@ -84,6 +84,8 @@ def main() -> int:
             "rate_rps": RATE_LOW,
             "offered_tokens_s": low["offered_tokens_s"],
             "throughput_tokens_s": low["throughput_tokens_s"],
+            "prefill_tokens_s": low["prefill_tokens_s"],
+            "decode_tokens_s": low["decode_tokens_s"],
             "ttft_p99_s": low["ttft_s"].get("p99"),
             "itl_p99_s": low["itl_s"].get("p99"),
             "schedule_digest": low["schedule_digest"],

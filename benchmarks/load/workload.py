@@ -83,6 +83,42 @@ class WorkloadSpec:
             raise ValueError("tenants must be non-empty")
 
 
+#: Named workload presets (``preset(name)`` materializes one).
+#: ``disagg`` is the LONG-TAIL PREFILL mix that reproduces the
+#: decode-stall pathology on the collocated serving path: heavy-tailed
+#: prompt lengths with a fat p99 (sigma 1.6 around a short median —
+#: most prompts are a few pages, the tail is an order of magnitude
+#: longer) and SHORT outputs, so decode ticks are cheap and any ITL
+#: p99 inflation is attributable to in-tick prefill work
+#: (``continuous.prefill_stall_s``). ``benchmarks/load/disagg_smoke``
+#: runs the same schedule through both placements and gates the
+#: disaggregated win on it.
+PRESETS: dict[str, dict] = {
+    "disagg": dict(
+        prompt_median=24,
+        prompt_sigma=1.6,
+        prompt_max=1024,
+        steps_median=24,
+        steps_sigma=0.3,
+        steps_max=48,
+        ttft_budget_s=3.0,
+        itl_budget_s=2.0,
+    ),
+}
+
+
+def preset(name: str, **overrides) -> WorkloadSpec:
+    """A named :class:`WorkloadSpec` preset, with per-field overrides
+    (``preset("disagg", duration_s=4.0)``)."""
+    try:
+        base = PRESETS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown preset {name!r}; have {sorted(PRESETS)}"
+        ) from None
+    return WorkloadSpec(**{**base, **overrides})
+
+
 @dataclasses.dataclass(frozen=True)
 class Arrival:
     """One scheduled request (everything the driver needs to submit)."""
