@@ -293,6 +293,132 @@ class DisaggConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TenantQuota:
+    """Per-tenant traffic-control knobs (``config.SchedulerConfig``;
+    ``runtime/scheduler.AdmissionQueue``). ``weight`` is the tenant's
+    deficit-round-robin share within its priority class (a weight-2
+    tenant drains twice the requests of a weight-1 tenant under
+    backlog); ``burst`` caps how many of its requests may sit QUEUED
+    at once (admission beyond it rejects synchronously with
+    ``QueueFullError`` — the per-tenant flood bound; ``None`` leaves
+    only the global ``max_queue_depth`` bound)."""
+
+    weight: float = 1.0
+    burst: int | None = None
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"weight must be > 0, got {self.weight}")
+        if self.burst is not None and self.burst < 1:
+            raise ValueError(f"burst must be >= 1, got {self.burst}")
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    """Multi-tenant overload control in front of the continuous
+    batcher (``runtime/scheduler``; ``docs/SERVING.md`` "Traffic
+    control").
+
+    Three mechanisms, in the order they engage under rising load:
+
+    1. **Admission control** — the submit queue becomes a bounded
+       ``AdmissionQueue``: per-tenant FIFO queues drained by
+       deficit-round-robin within strict priority classes
+       (``SLOSpec.priority``; higher admits first), per-tenant
+       ``TenantQuota`` weights + burst caps, and a global
+       ``max_queue_depth``. A submit past a bound raises
+       ``QueueFullError`` SYNCHRONOUSLY (``request_rejected`` flight
+       event) — the client learns immediately and ``result()`` never
+       wedges on a request that was never accepted.
+    2. **Decode-slot preemption** — when a higher-priority request has
+       burned ``preempt_ttft_fraction`` of its TTFT budget waiting and
+       no slot is free, the scheduler preempts the lowest-priority
+       active decode slot through the elastic-recovery REPLAY path:
+       the victim's slot frees (paged: its prompt pages drop into the
+       prefix LRU), it re-queues (journal-reconstructed when one is
+       configured) and later re-admits as a prefix-cache hit, with
+       ``stream_skip`` suppressing re-delivery — exactly-once streams
+       and SLO verdicts carry across preemption exactly as they do
+       across a chip loss.
+    3. **Closed-loop degradation** — a per-tick controller reading the
+       engine/workload telemetry (queue depth, slot occupancy, TTFT
+       attainment) walks a shed ladder BEFORE preemption has to do the
+       work: shrink ``draft_k``, raise the disaggregated
+       ``busy_prompt_threshold``, evict cold prefix-cache pages, and
+       finally reject best-effort admits (``priority < 0``). Each
+       transition is a ``degradation_step`` flight event.
+    """
+
+    #: Global bound on queued (not yet admitted) requests across every
+    #: tenant — the bound behind ``ContinuousBatcher.submit`` (a full
+    #: slot map used to queue unboundedly).
+    max_queue_depth: int = 4096
+    #: DRR credit granted per service turn, multiplied by the tenant's
+    #: weight (request units — one request costs 1).
+    quantum: float = 1.0
+    #: Weight for tenants without an explicit ``TenantQuota``.
+    default_weight: float = 1.0
+    #: Per-tenant quotas, keyed by ``SLOSpec.tenant``.
+    quotas: dict[str, TenantQuota] = dataclasses.field(
+        default_factory=dict
+    )
+    #: Enable decode-slot preemption (mechanism 2).
+    preempt: bool = True
+    #: Fraction of a waiting high-priority request's TTFT budget that
+    #: may burn before the scheduler preempts for it. Requests with no
+    #: TTFT budget never trigger preemption.
+    preempt_ttft_fraction: float = 0.5
+    #: Enable the closed-loop degradation controller (mechanism 3).
+    degrade: bool = True
+    #: Escalate when queue depth / max_queue_depth reaches this while
+    #: occupancy is at/above ``degrade_occupancy`` (or windowed TTFT
+    #: attainment falls below ``degrade_attainment`` with a backlog).
+    degrade_queue_high: float = 0.5
+    #: De-escalate when queue depth / max_queue_depth falls to this.
+    degrade_queue_low: float = 0.05
+    #: Slot-occupancy fraction that counts as saturated.
+    degrade_occupancy: float = 1.0
+    #: Windowed TTFT attainment below this (with a backlog) also
+    #: escalates.
+    degrade_attainment: float = 0.9
+    #: Minimum dwell between ladder transitions (hysteresis).
+    degrade_dwell_s: float = 0.25
+
+    def __post_init__(self):
+        if self.max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got "
+                f"{self.max_queue_depth}"
+            )
+        if self.quantum <= 0:
+            raise ValueError(f"quantum must be > 0, got {self.quantum}")
+        if self.default_weight <= 0:
+            raise ValueError(
+                f"default_weight must be > 0, got {self.default_weight}"
+            )
+        if not 0.0 < self.preempt_ttft_fraction <= 1.0:
+            raise ValueError(
+                f"preempt_ttft_fraction must be in (0, 1], got "
+                f"{self.preempt_ttft_fraction}"
+            )
+        if not 0.0 <= self.degrade_queue_low <= self.degrade_queue_high:
+            raise ValueError(
+                "degrade_queue_low must be in [0, degrade_queue_high] "
+                f"({self.degrade_queue_low} vs {self.degrade_queue_high})"
+            )
+        if not 0.0 <= self.degrade_occupancy <= 1.0:
+            raise ValueError(
+                f"degrade_occupancy must be in [0, 1], got "
+                f"{self.degrade_occupancy}"
+            )
+        if self.degrade_dwell_s < 0:
+            raise ValueError(
+                f"degrade_dwell_s must be >= 0, got "
+                f"{self.degrade_dwell_s}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class SLOSpec:
     """Per-request latency budget, evaluated by the serving tier's
     existing lifecycle stamps (``runtime/continuous`` request
@@ -315,6 +441,15 @@ class SLOSpec:
     itl_budget_s: float | None = None
     #: Accounting label for the per-tenant met/missed counters.
     tenant: str = "default"
+    #: Scheduling class (``config.SchedulerConfig`` /
+    #: ``runtime/scheduler.AdmissionQueue``): higher admits strictly
+    #: first under backlog and may PREEMPT a lower class's decode slot
+    #: when its TTFT budget is at risk; ``< 0`` marks the request
+    #: best-effort — the degradation ladder's final rung rejects those
+    #: admits outright. 0 (the default) is the ordinary class; without
+    #: a ``SchedulerConfig`` on the batcher, priority is carried but
+    #: inert.
+    priority: int = 0
 
     def __post_init__(self):
         for name in ("ttft_budget_s", "itl_budget_s"):
@@ -405,4 +540,7 @@ class ServeConfig:
     )
     disagg: DisaggConfig = dataclasses.field(
         default_factory=DisaggConfig
+    )
+    scheduler: SchedulerConfig = dataclasses.field(
+        default_factory=SchedulerConfig
     )

@@ -3,13 +3,21 @@ from adapt_tpu.runtime.decode_pipeline import PipelinedDecoder
 from adapt_tpu.runtime.disagg import DisaggServer, PrefillWorker
 from adapt_tpu.runtime.paged import Pager
 from adapt_tpu.runtime.pipeline import LocalPipeline, ServingPipeline
+from adapt_tpu.runtime.scheduler import (
+    AdmissionQueue,
+    DegradationController,
+    QueueFullError,
+)
 
 __all__ = [
+    "AdmissionQueue",
     "ContinuousBatcher",
+    "DegradationController",
     "DisaggServer",
     "LocalPipeline",
     "Pager",
     "PipelinedDecoder",
     "PrefillWorker",
+    "QueueFullError",
     "ServingPipeline",
 ]

@@ -218,6 +218,24 @@ state migration. Lifecycle: ``device_lost`` / ``mesh_reshard`` /
 ``recovery.wall_s`` histogram and ``recovery.{migrated,replayed,
 dropped}_total`` counters.
 
+**Traffic control** (``scheduler=`` + ``config.SchedulerConfig``;
+``runtime/scheduler``; ``docs/SERVING.md`` "Traffic control"): the
+submit queue is a bounded ``AdmissionQueue`` — per-tenant quotas
+(weights + burst caps), deficit-round-robin weighted fair queueing
+inside strict priority classes (``SLOSpec.priority``), and explicit
+synchronous rejection (``QueueFullError`` + ``request_rejected``
+flight event) at the global or per-tenant bound, so a full slot map
+no longer queues unboundedly and ``result()`` never wedges on a
+request that was never accepted. A high-priority request that burns
+its TTFT headroom waiting preempts the lowest-priority decode slot
+through the recovery REPLAY path (prompt pages into the prefix LRU,
+journal-requeue, ``stream_skip``-suppressed re-delivery — exactly-once
+across preemption, SLO verdicts carried). A per-tick
+``DegradationController`` sheds load before preemption has to:
+shrink ``draft_k``, raise the disaggregated busy threshold, evict
+cold cached pages, reject best-effort admits. Without a
+``SchedulerConfig`` the queue degrades to the bounded FIFO.
+
 Not in scope (v1): pipeline-parallel slots (compose with the pipelined
 decoders for models bigger than a TP group).
 """
@@ -247,6 +265,7 @@ from jax.sharding import (
 from adapt_tpu.config import (
     ParallelConfig,
     RecoveryConfig,
+    SchedulerConfig,
     SLOSpec,
     SpeculativeConfig,
 )
@@ -268,6 +287,13 @@ from adapt_tpu.parallel.sharding import (
     tree_shardings,
 )
 from adapt_tpu.runtime.paged import Pager, insert_prefill_pages
+from adapt_tpu.runtime.scheduler import (
+    AdmissionQueue,
+    DegradationController,
+    QueueFullError,
+    request_priority,
+    request_tenant,
+)
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
 from adapt_tpu.utils.profiling import (
@@ -431,6 +457,7 @@ class ContinuousBatcher:
         recovery: RecoveryConfig | None = None,
         health=None,
         journal=None,
+        scheduler: SchedulerConfig | None = None,
     ):
         self.lm = lm
         # -- tensor parallelism (mesh-native serving) ----------------------
@@ -547,6 +574,17 @@ class ContinuousBatcher:
         else:
             self._spec = None
         self._spec_k = self._spec.draft_k if self._spec else 0
+        #: EFFECTIVE proposals per round — the degradation ladder's
+        #: first rung shrinks it at runtime (:meth:`set_draft_k`).
+        #: Cache geometry, admission slack and the idle sentinel all
+        #: size for the CONFIGURED ``draft_k`` (the maximum), so a
+        #: shrunk round's writes always land inside reserved space;
+        #: only the per-tick draft scan and verify chunk narrow.
+        self._spec_k_eff = self._spec_k
+        #: draft_k values whose spec-program variants have already
+        #: been granted a compile allowance (each distinct k lowers
+        #: one fresh draft/verify variant; toggling back reuses it).
+        self._spec_k_granted = {self._spec_k}
         self._draft_lm = draft_lm
         self._draft_variables = draft_variables
         if kv_cache_dtype not in ("native", "int8"):
@@ -770,7 +808,31 @@ class ContinuousBatcher:
         #: recycling) — a steady-state paged tick stages nothing.
         self._table_dev = None
         self._table_snapshot = None
-        self._queue: collections.deque[_Request] = collections.deque()
+        # -- traffic control (docs/SERVING.md "Traffic control") -----------
+        #: The submit queue is a runtime/scheduler.AdmissionQueue even
+        #: without an explicit SchedulerConfig: bounded (the default
+        #: max_queue_depth — a full slot map used to queue
+        #: unboundedly) but otherwise STRICT FIFO, so a batcher that
+        #: never opted into traffic control keeps its exact
+        #: pre-scheduler admission order. An explicit config adds
+        #: tenant quotas, weighted fair queueing, priority classes,
+        #: preemption and the degradation controller.
+        self._sched = scheduler
+        self._queue: AdmissionQueue = AdmissionQueue(scheduler)
+        self._controller = (
+            DegradationController(scheduler)
+            if scheduler is not None and scheduler.degrade
+            else None
+        )
+        #: Traffic-control books (instance-lifetime, _cv-guarded —
+        #: mirrors of the scheduler.{rejected,preempted}_total
+        #: counters).
+        self._rejected = 0
+        self._preempted = 0
+        #: Tenants currently holding a scheduler.queue_depth gauge —
+        #: tick prunes gauges the queue's bounded tenant map evicted,
+        #: so adversarial fresh-label floods cannot grow the registry.
+        self._gauged_tenants: set[str] = set()
         self._done: dict[int, np.ndarray] = {}
         #: Per-request logprob streams, claimable via logprobs() after
         #: the tokens are fetched. BOUNDED: callers that never claim
@@ -1245,7 +1307,13 @@ class ContinuousBatcher:
         paged = table is not None
         caches = self._shard_kv(caches)
         dstate = self._repl_state(dstate)
-        d = self._spec_k
+        # The round's speculation depth comes from the DRAFT OUTPUT's
+        # static shape, not self._spec_k: the degradation ladder
+        # shrinks the effective draft_k at runtime (set_draft_k), and
+        # each distinct depth is its own jit variant keyed by this
+        # aval — reading the attribute would silently bake the
+        # construction-time value into every variant.
+        d = dtoks.shape[0] - 1
         tok, pos = dstate["tok"], dstate["pos"]
         active = dstate["active"]
         props = jnp.swapaxes(dtoks[:d], 0, 1)  # (B, d)
@@ -1837,6 +1905,30 @@ class ContinuousBatcher:
             ),
             slo=slo,
         )
+        def _reject(e: QueueFullError, journaled: bool) -> None:
+            self._record_rejection(
+                request_tenant(req), request_priority(req), e,
+                request=req_id,
+            )
+            if journaled:
+                # Done-mark so a crash recovery cannot resurrect a
+                # request the client was told was rejected.
+                self._journal_done(req_id)
+
+        # Shed a flood BEFORE paying journal I/O: under sustained
+        # overload (the regime rejection exists for) every rejected
+        # submit would otherwise serialize its full payload record
+        # plus a done mark. The bounded append below stays the
+        # authoritative check — this is the same pre-check/backstop
+        # split as admission_check's.
+        try:
+            with self._cv:
+                self._queue.check(
+                    request_tenant(req), request_priority(req)
+                )
+        except QueueFullError as e:
+            _reject(e, journaled=False)
+            raise
         if self._journal is not None:
             # Payload + knobs BEFORE the request becomes reachable: a
             # replay (elastic recovery) or a crash-recovering process
@@ -1861,9 +1953,16 @@ class ContinuousBatcher:
                 log.warning(
                     "journal submit failed for %d: %r", req_id, e
                 )
-        with self._cv:
-            self._queue.append(req)
-            self._cv.notify_all()  # wake the server thread, if any
+        try:
+            with self._cv:
+                self._queue.append(req)  # bounded: may raise
+                self._cv.notify_all()  # wake the server thread, if any
+        except QueueFullError as e:
+            # Synchronous rejection IS the admission-control contract:
+            # the caller learns now — no id ever waits on result().
+            _reject(e, journaled=True)
+            raise
+        global_metrics().inc("scheduler.admitted_total")
         return req.req_id
 
     def cancel(self, req_id: int) -> bool:
@@ -1880,29 +1979,27 @@ class ContinuousBatcher:
         with self._cv:
             if req_id in self._done or not 0 <= req_id < self._next_id:
                 return False
-            for i, req in enumerate(self._queue):
-                if req.req_id == req_id:
-                    del self._queue[i]
-                    # A marker from an earlier cancel of this id (e.g.
-                    # while it was mid-admission before being re-queued
-                    # on pool pressure) must not outlive it.
-                    self._cancelled.discard(req_id)
-                    # A freshly queued request delivered nothing, but a
-                    # recovery-replayed one waiting for re-admission
-                    # already streamed its first life's tokens: result()
-                    # returns that snapshot, matching what a live cancel
-                    # after re-admission would return.
-                    if req.delivered_tokens is not None:
-                        self._done[req_id] = req.delivered_tokens
-                        self._done_lps[req_id] = req.delivered_lps
-                    else:
-                        self._done[req_id] = np.zeros((0,), np.int32)
-                        self._done_lps[req_id] = np.zeros((0,), np.float32)
-                    self._cv.notify_all()
-                    global_flight_recorder().record(
-                        "cancel", request=req_id, state="queued"
-                    )
-                    break
+            req = self._queue.remove_id(req_id)
+            if req is not None:
+                # A marker from an earlier cancel of this id (e.g.
+                # while it was mid-admission before being re-queued
+                # on pool pressure) must not outlive it.
+                self._cancelled.discard(req_id)
+                # A freshly queued request delivered nothing, but a
+                # recovery-replayed one waiting for re-admission
+                # already streamed its first life's tokens: result()
+                # returns that snapshot, matching what a live cancel
+                # after re-admission would return.
+                if req.delivered_tokens is not None:
+                    self._done[req_id] = req.delivered_tokens
+                    self._done_lps[req_id] = req.delivered_lps
+                else:
+                    self._done[req_id] = np.zeros((0,), np.int32)
+                    self._done_lps[req_id] = np.zeros((0,), np.float32)
+                self._cv.notify_all()
+                global_flight_recorder().record(
+                    "cancel", request=req_id, state="queued"
+                )
             else:
                 # Live = bound to a slot, or mid-admission on the
                 # ticking thread (popped, not yet slot-bound). Anything
@@ -1925,6 +2022,171 @@ class ContinuousBatcher:
         # — _finish and _drop_slot keep the same discipline.
         self._journal_done(req_id)
         return True
+
+    # -- traffic control (docs/SERVING.md "Traffic control") ---------------
+
+    def _record_rejection(
+        self,
+        tenant: str,
+        prio: int,
+        err: Exception,
+        request: int | None = None,
+    ) -> None:
+        """THE one rejection-bookkeeping body (books + counter +
+        ``request_rejected`` flight event) — submit's bounded append,
+        its pre-journal check and :meth:`admission_check` all go
+        through here, so a new event field cannot silently diverge
+        across the three rejection sites."""
+        with self._cv:
+            self._rejected += 1
+        global_metrics().inc("scheduler.rejected_total")
+        ev = {
+            "tenant": tenant,
+            "priority": prio,
+            "reason": str(err)[:200],
+        }
+        if request is not None:
+            ev["request"] = request
+        global_flight_recorder().record("request_rejected", **ev)
+
+    def admission_check(
+        self, slo: SLOSpec | None = None, request: int | None = None
+    ) -> None:
+        """Raise :class:`~adapt_tpu.runtime.scheduler.QueueFullError`
+        iff a :meth:`submit` carrying ``slo`` would be rejected by
+        admission control right now, recording the rejection exactly
+        like submit does. The disaggregated path
+        (``runtime/disagg.DisaggServer``) calls this BEFORE routing a
+        request into the prefill tier, so a doomed request fails
+        synchronously instead of after its whole prefill ran (the
+        landing-time rejection still backs up the race)."""
+        tenant = slo.tenant if slo is not None else "default"
+        prio = slo.priority if slo is not None else 0
+        try:
+            with self._cv:
+                self._queue.check(tenant, prio)
+        except QueueFullError as e:
+            self._record_rejection(tenant, prio, e, request=request)
+            raise
+
+    def _maybe_preempt(self) -> None:
+        """Decode-slot preemption (ticking thread, start of admission):
+        when the queue's top priority class has a request whose TTFT
+        budget has burned past ``preempt_ttft_fraction`` waiting and
+        neither a slot nor (paged) the pages it needs can free
+        otherwise, preempt the LOWEST-priority active decode slot
+        through the replay path (:meth:`_replay_slot` — prompt pages
+        into the prefix LRU, journal-requeue, exactly-once
+        re-delivery). At most one victim per tick: admission runs
+        right after, so the freed slot serves the waiting request
+        before a second preemption could be justified."""
+        sched = self._sched
+        if sched is None or not sched.preempt:
+            return
+        with self._cv:
+            if len(self._queue) == 0:
+                return
+            cand = self._queue.preempt_candidate()
+        if cand is None:
+            return
+        req, prio = cand
+        if any(s.req is None for s in self.slots):
+            # A free slot exists — ordinary admission serves the head,
+            # UNLESS it is PAGE-starved: paged admission is
+            # all-or-nothing, and a head whose worst-case reservation
+            # the pool cannot cover even after evicting every cold
+            # page (can_alloc counts the LRU) waits at the free slot
+            # forever while lower-priority decodes hold the pages.
+            # Preempting one releases its pages into the evictable
+            # set. The need bound is conservative — prefix sharing
+            # only shrinks it, so can_alloc(need) true means ordinary
+            # admission will succeed.
+            if not self._paged:
+                return
+            s0 = req.prompt.shape[0]
+            bucket = next(b for b in self.prompt_buckets if b >= s0)
+            need = -(
+                -max(bucket, s0 + req.steps + self._spec_k)
+                // self._page
+            )
+            if self._pager.can_alloc(need):
+                return
+        waited = time.perf_counter() - (req.t_requeued or req.t_submit)
+        if waited < sched.preempt_ttft_fraction * req.slo.ttft_budget_s:
+            return
+        with self._cv:
+            # Re-validate: a client cancel() since the candidate
+            # snapshot removed it from the queue — preempting a live
+            # decode (discarded tokens, full replay) to serve a
+            # request that no longer exists would be pure waste.
+            if not any(
+                r.req_id == req.req_id for r in self._queue
+            ):
+                return
+        victims = [
+            s for s in self.slots
+            if s.req is not None
+            and s.pf_done < 0  # decode slots only; mid-prefill slots
+            # finish their admission (they have emitted nothing yet)
+            and request_priority(s.req) < prio
+        ]
+        if not victims:
+            return  # never preempt an equal-or-higher class
+        # Lowest class first; ties broken by FEWEST emitted tokens —
+        # the cheapest regeneration when the victim re-admits.
+        victim = min(
+            victims,
+            key=lambda s: (request_priority(s.req), len(s.tokens)),
+        )
+        vid = victim.req.req_id
+        vprio = request_priority(victim.req)
+        delivered = len(victim.tokens)
+        self._replay_slot(
+            victim, event="preempted", extra={"for_request": req.req_id}
+        )
+        with self._cv:
+            self._preempted += 1
+        global_metrics().inc("scheduler.preempted_total")
+        log.info(
+            "preempted request %d (priority %d, %d tokens delivered) "
+            "for request %d (priority %d, waited %.3fs of %.3fs TTFT)",
+            vid, vprio, delivered, req.req_id, prio, waited,
+            req.slo.ttft_budget_s,
+        )
+
+    def set_draft_k(self, k: int) -> None:
+        """Shrink (or restore) the EFFECTIVE speculation depth at
+        runtime — the degradation ladder's cheapest rung
+        (``runtime/scheduler.DegradationController``). Cache slack,
+        page reservations and the idle sentinel all sized for the
+        CONFIGURED ``draft_k`` at construction, so any ``k`` in
+        ``[1, draft_k]`` keeps every write inside reserved space; the
+        next tick's draft scan and verify chunk simply narrow to
+        ``k + 1`` rows. Each DISTINCT ``k`` lowers one fresh variant
+        of the two spec programs (granted as an expected-compile
+        allowance, like recovery's re-lowers — not a phantom-variant
+        alarm); toggling back to a seen value reuses its cached
+        executables."""
+        if self._spec is None:
+            raise ValueError(
+                "set_draft_k requires speculative mode (draft_lm=)"
+            )
+        if not 1 <= k <= self._spec.draft_k:
+            raise ValueError(
+                f"draft_k must be in [1, {self._spec.draft_k}], got {k}"
+            )
+        if k == self._spec_k_eff:
+            return
+        if k not in self._spec_k_granted:
+            for prog in (
+                "continuous.spec_verify", "speculative.draft_chunk"
+            ):
+                self._sentinel.rearm(prog, expect=1)
+                self._granted[prog] = self._granted.get(prog, 0) + 1
+            self._spec_k_granted.add(k)
+        self._spec_k_eff = k
+        log.info("effective draft_k -> %d (configured %d)",
+                 k, self._spec.draft_k)
 
     # -- elastic mesh recovery ---------------------------------------------
 
@@ -2159,8 +2421,16 @@ class ContinuousBatcher:
                 "continuous.adopt_pages"
             )
         if self._spec:
-            expected["continuous.spec_verify"] = 1
-            expected["speculative.draft_chunk"] = 1
+            # One re-lower per speculation DEPTH dispatched under the
+            # old epoch (the degradation ladder's set_draft_k makes
+            # several possible); a spec batcher that never ticked
+            # still re-lowers its first tick's variant.
+            expected["continuous.spec_verify"] = (
+                nvar("continuous.spec_verify") or 1
+            )
+            expected["speculative.draft_chunk"] = (
+                nvar("speculative.draft_chunk") or 1
+            )
         else:
             expected["continuous.step_chunk"] = nvar(
                 "continuous.step_chunk"
@@ -2278,7 +2548,12 @@ class ContinuousBatcher:
         )
         return summary
 
-    def _replay_slot(self, slot: _Slot) -> None:
+    def _replay_slot(
+        self,
+        slot: _Slot,
+        event: str = "replayed_from_journal",
+        extra: dict | None = None,
+    ) -> None:
         """Replay one slot's request instead of migrating it: free the
         slot (paged: its registered prompt pages drop into the prefix
         LRU, so the re-admission re-enters through the prefix cache —
@@ -2287,7 +2562,15 @@ class ContinuousBatcher:
         the JOURNAL when one is configured (payload + sampling-knob
         meta; the in-memory record is the fallback). Greedy replays
         re-emit the identical stream; sampled ones re-use the
-        journaled key schedule — identical too."""
+        journaled key schedule — identical too.
+
+        Decode-slot PREEMPTION (``runtime/scheduler``) rides this
+        exact path with ``event="preempted"``: cancel the slot,
+        prompt pages into the prefix LRU, journal-requeue, re-admit
+        later as a prefix-cache hit with ``stream_skip`` suppressing
+        re-delivery — preemption reuses recovery's exactly-once and
+        SLO-carry-across-lives discipline instead of inventing a
+        second one."""
         req = slot.req
         # Tokens already DELIVERED to the client across this request's
         # lives (a double-kill chain replays a replay: slot.tokens
@@ -2352,11 +2635,12 @@ class ContinuousBatcher:
         # the journal reconstruction, which was built with it)
         req.t_requeued = time.perf_counter()
         global_flight_recorder().record(
-            "replayed_from_journal",
+            event,
             request=req.req_id,
             slot=slot.idx,
             source=source,
             tokens_discarded=len(slot.tokens),
+            **(extra or {}),
         )
         with self._cv:
             self._release_slot(slot)
@@ -2681,6 +2965,10 @@ class ContinuousBatcher:
             self._finish(slot)
 
     def _admit(self) -> None:
+        # Traffic control: a high-priority request past its TTFT
+        # headroom may free a slot here (replay-path preemption); the
+        # loop below then admits it first (popleft is priority-first).
+        self._maybe_preempt()
         for i, slot in enumerate(self.slots):
             if slot.req is not None:
                 continue
@@ -3028,7 +3316,9 @@ class ContinuousBatcher:
         fetches the round's (tokens, logprobs, accepted) in ONE host
         sync. Returns host-side ((d+1, B) tokens, logprobs, (B,)
         per-slot commit limits)."""
-        d = self._spec_k
+        d = self._spec_k_eff
+        self._variants.setdefault("speculative.draft_chunk", set()).add(d)
+        self._variants.setdefault("continuous.spec_verify", set()).add(d)
         eo = self._eobs
         # Snapshot the gate ONCE per call: flipping obs_engine while a
         # tick is in flight must never pair a 0.0 open with an enabled
@@ -3134,6 +3424,10 @@ class ContinuousBatcher:
         of every tick, so an unexpected recompile is flagged next to
         the tick that paid for it."""
         self._ensure_mesh()
+        if self._controller is not None:
+            # Closed-loop degradation BEFORE admission: this tick's
+            # admits see the ladder's current shed level.
+            self._controller.step(self)
         eo = self._eobs
         # Snapshot the gate ONCE per tick (see _spec_decode).
         eo_on = eo.enabled
@@ -3191,6 +3485,22 @@ class ContinuousBatcher:
                 if s.req is not None and s.pf_done >= 0),
         )
         global_metrics().set_gauge("continuous.queue_depth", len(self._queue))
+        if self._sched is not None:
+            # Per-tenant queue-depth gauges — bounded cardinality: the
+            # queue retains at most _MAX_TENANTS drained tenants (so
+            # recent ones read 0 instead of going stale), and gauges
+            # for tenants it evicted are removed here in step.
+            with self._cv:
+                depths = self._queue.depths()
+            for tenant in self._gauged_tenants - depths.keys():
+                global_metrics().remove_gauge(
+                    f"scheduler.queue_depth.{tenant}"
+                )
+            for tenant, depth in depths.items():
+                global_metrics().set_gauge(
+                    f"scheduler.queue_depth.{tenant}", float(depth)
+                )
+            self._gauged_tenants = set(depths)
         # Bridge PR-1's fused-staging counter to /metrics: transfers are
         # cumulative, so dashboards derive the steady-state rate (the
         # contract: flat between admissions).
@@ -3369,7 +3679,15 @@ class ContinuousBatcher:
                 "slo_ttft_missed": self._slo_totals["ttft_missed"],
                 "slo_itl_met": self._slo_totals["itl_met"],
                 "slo_itl_missed": self._slo_totals["itl_missed"],
+                # Traffic-control books (mirrors of the scheduler.*
+                # registry counters). "queued" above is the BOUNDED
+                # admission-queue depth — it can never exceed the
+                # scheduler's max_queue_depth.
+                "rejected": self._rejected,
+                "preempted": self._preempted,
             }
+            if self._controller is not None:
+                out["degradation_level"] = self._controller.level
             if self._spec is not None:
                 out["spec_drafted"] = self._spec_drafted
                 out["spec_accepted"] = self._spec_accepted

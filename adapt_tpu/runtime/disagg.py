@@ -92,6 +92,7 @@ from adapt_tpu.config import DisaggConfig, SLOSpec
 from adapt_tpu.models.transformer_lm import TransformerLM
 from adapt_tpu.runtime.continuous import ContinuousBatcher
 from adapt_tpu.runtime.paged import Pager
+from adapt_tpu.runtime.scheduler import QueueFullError
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
 from adapt_tpu.utils.profiling import (
@@ -666,6 +667,13 @@ class DisaggServer:
         self.disaggregated = 0
         self.collocated = 0
         self.failed = 0
+        # Closed-loop degradation: a scheduler-configured decode
+        # batcher's controller gains its busy-threshold rung the
+        # moment this server fronts it (the controller holds the
+        # server weakly — see runtime/scheduler).
+        ctrl = getattr(decode, "_controller", None)
+        if ctrl is not None:
+            ctrl.attach_disagg(self)
 
     # -- placement ---------------------------------------------------------
 
@@ -674,9 +682,15 @@ class DisaggServer:
             return True
         return self._lease_key in self._registry.alive(role="prefill")
 
-    def _placement(self, s0: int) -> bool:
+    def _placement(self, s0: int, slo: SLOSpec | None = None) -> bool:
         """True = disaggregate. The knobs live in
-        ``config.DisaggConfig``; every fallback is collocated."""
+        ``config.DisaggConfig``; every fallback is collocated.
+        PRIORITY is visible here (``SLOSpec.priority``): a
+        high-priority request (> 0) always sees the tight BUSY
+        threshold — its TTFT budget is the one the decode tier's
+        in-tick prefill stalls would blow, and its long prompt is
+        exactly the work the decode tier must not pay inline while
+        lower classes wait on inter-token latency."""
         m = (s0 - 1) // self.decode._page
         if m < 1:
             return False  # nothing to hand off
@@ -684,9 +698,12 @@ class DisaggServer:
         occupancy = sum(
             1 for s in slots if s.req is not None
         ) / len(slots)
+        busy = occupancy >= self.cfg.busy_occupancy or (
+            slo is not None and slo.priority > 0
+        )
         threshold = (
             self.cfg.busy_prompt_threshold
-            if occupancy >= self.cfg.busy_occupancy
+            if busy
             else self.cfg.prompt_threshold
         )
         if s0 < threshold:
@@ -757,7 +774,13 @@ class DisaggServer:
             slo=slo,
         )
         now = time.perf_counter()
-        if self._placement(s0):
+        if self._placement(s0, slo):
+            # Admission-control pre-check (records the rejection like
+            # a collocated submit's would): a request the decode queue
+            # would reject RIGHT NOW must fail synchronously, not
+            # after its whole prefill ran — the landing-time rejection
+            # in _land still backs up the race window.
+            dec.admission_check(slo, request=sid)
             self.disaggregated += 1
             global_metrics().inc("disagg.disaggregated_total")
             self._route[sid] = _Routed(
@@ -864,7 +887,13 @@ class DisaggServer:
             rid = self.decode.submit(
                 prompt, t_submit=r.t_submit, **kwargs
             )
-        except (ValueError, TypeError) as e:
+        except (ValueError, TypeError, QueueFullError) as e:
+            # QueueFullError: admission control filled up while the
+            # prefill ran. The adopted pages stay registered rc=0 in
+            # the prefix LRU (land-then-LRU — evictable capacity, or
+            # a free prefix hit for a retry), the prefill tier's own
+            # pages were already freed at handoff, and ONLY this
+            # request fails; the batcher recorded request_rejected.
             self._fail(sid, e)
             return
         r.tier, r.rid, r.kwargs = "decode", rid, None

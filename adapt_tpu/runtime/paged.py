@@ -255,6 +255,25 @@ class Pager:
             out.append((i, page))
         return out
 
+    def evict_cached(self, n: int | None = None) -> int:
+        """Evict up to ``n`` (default: all) COLD prefix-cache pages —
+        rc=0 LRU residents, oldest first — back to the free list,
+        dropping their content keys. The degradation ladder's sweep
+        rung (``runtime/scheduler``): capacity-NEUTRAL by construction
+        (``can_alloc`` already counts the LRU and ``alloc`` evicts on
+        demand), it trades the cache's speculative prefix-hit value
+        for the allocator's free-list fast path under overload. Live
+        (rc>0) pages are untouched; the pool partition (used + free +
+        cached) is conserved. Returns the count evicted."""
+        evicted = 0
+        while self._lru and (n is None or evicted < n):
+            page, _ = self._lru.popitem(last=False)
+            key = self._key_of.pop(page)
+            del self._by_key[key]
+            self._free.append(page)
+            evicted += 1
+        return evicted
+
     def register(self, page: int, key: bytes) -> None:
         """Publish ``page`` (currently owned, rc>=1) as the cache entry
         for ``key``. First writer wins; a page may carry one key."""

@@ -144,26 +144,37 @@ def drive_phase(
     from adapt_tpu.utils.metrics import global_metrics
     from adapt_tpu.utils.tracing import global_flight_recorder
 
+    from adapt_tpu.runtime.scheduler import QueueFullError
+
     reg = registry if registry is not None else global_metrics()
     recorder = global_flight_recorder()
     finishes0 = recorder.kind_counts().get("finish", 0)
     n = len(schedule)
     counts = [0] * n  # emitted tokens per scheduled request
     cancelled = [False] * n
+    #: Admission-control rejections (bounded queue / burst caps /
+    #: best-effort shed — traffic-control arms only). A rejected
+    #: request never produces a finish edge, so the drain loop and
+    #: the per-request books both subtract it.
+    rejected = [False] * n
+    submit_wall = [0.0] * n
+    ttfts: list[float | None] = [None] * n
 
     def make_cb(i: int, a: Arrival):
-        if a.cancel_after is None:
-            def cb(rid, tok, idx, _i=i):
-                counts[_i] += 1
-        else:
-            def cb(rid, tok, idx, _i=i, _c=a.cancel_after):
-                counts[_i] += 1
-                if counts[_i] == _c:
-                    # Token-space cancel mark: the marker is consumed
-                    # at the next commit boundary, so the final stream
-                    # length is deterministic (exactly _c tokens).
-                    cancelled[_i] = True
-                    bat.cancel(rid)
+        def cb(rid, tok, idx, _i=i, _c=a.cancel_after):
+            if ttfts[_i] is None:
+                # Driver-side per-request TTFT (wall clock from the
+                # scheduled submit): the per-TENANT attainment split
+                # the overload gate needs, without growing registry
+                # cardinality per tenant.
+                ttfts[_i] = time.perf_counter() - submit_wall[_i]
+            counts[_i] += 1
+            if _c is not None and counts[_i] == _c:
+                # Token-space cancel mark: the marker is consumed
+                # at the next commit boundary, so the final stream
+                # length is deterministic (exactly _c tokens).
+                cancelled[_i] = True
+                bat.cancel(rid)
         return cb
 
     win = reg.snapshot(window=True)
@@ -174,19 +185,24 @@ def drive_phase(
         now = time.perf_counter() - t0
         while pi < n and schedule[pi].t <= now:
             a = schedule[pi]
-            bat.submit(
-                np.asarray(a.prompt, np.int32),
-                a.steps,
-                slo=SLOSpec(
-                    ttft_budget_s=spec.ttft_budget_s,
-                    itl_budget_s=spec.itl_budget_s,
-                    tenant=a.tenant,
-                ),
-                on_token=make_cb(pi, a),
-            )
+            submit_wall[pi] = time.perf_counter()
+            try:
+                bat.submit(
+                    np.asarray(a.prompt, np.int32),
+                    a.steps,
+                    slo=SLOSpec(
+                        ttft_budget_s=spec.ttft_budget_s,
+                        itl_budget_s=spec.itl_budget_s,
+                        tenant=a.tenant,
+                        priority=a.priority,
+                    ),
+                    on_token=make_cb(pi, a),
+                )
+            except QueueFullError:
+                rejected[pi] = True
             pi += 1
         finished = recorder.kind_counts().get("finish", 0) - finishes0
-        if pi >= n and finished >= n:
+        if pi >= n and finished >= n - sum(rejected):
             break
         if now > wall_guard_s:
             raise RuntimeError(
@@ -281,8 +297,11 @@ def drive_phase(
         "itl_s": pct("continuous.itl_s"),
         "queue_wait_s": pct("continuous.queue_wait_s"),
         "cancelled": int(sum(cancelled)),
+        "rejected": int(sum(rejected)),
         "tokens_delivered": int(sum(counts)),
         "token_counts": counts,
+        "request_ttfts": ttfts,
+        "rejected_flags": rejected,
         "ticks": bat.stats()["ticks"] - ticks0,
         "wall_s": round(wall_s, 3),
         "window_s": round(window_s, 3),
@@ -319,10 +338,12 @@ def build_batcher(
     chunk: int,
     layout: str = "slots",
     page_size: int = 128,
+    scheduler=None,
 ):
     """The harness's model+batcher factory (CPU-forced; tiny LM — the
     harness measures the serving tier's behavior under load, not model
-    quality)."""
+    quality). ``scheduler`` (a ``config.SchedulerConfig``) turns the
+    traffic-control tier on — the quota-on arm of an overload A/B."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
     import jax.numpy as jnp
@@ -336,6 +357,8 @@ def build_batcher(
         jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
     )
     kw = {"page_size": page_size} if layout == "paged" else {}
+    if scheduler is not None:
+        kw["scheduler"] = scheduler
     return ContinuousBatcher(
         lm, variables, slots=slots, chunk=chunk, kv_layout=layout, **kw
     )
@@ -350,6 +373,7 @@ def build_disagg(
     prefill_chunk: int | None = None,
     prompt_threshold: int = 48,
     busy_prompt_threshold: int | None = None,
+    scheduler=None,
 ):
     """The disaggregated counterpart of :func:`build_batcher`: a paged
     decode batcher, a chunked ``PrefillWorker`` and the
@@ -359,7 +383,7 @@ def build_disagg(
     defaults to two pages (the per-tick stall bound)."""
     decode = build_batcher(
         vocab, max_len, slots, chunk, layout="paged",
-        page_size=page_size,
+        page_size=page_size, scheduler=scheduler,
     )
     from adapt_tpu.config import DisaggConfig
     from adapt_tpu.runtime.disagg import DisaggServer, PrefillWorker
@@ -400,6 +424,13 @@ def main() -> int:
         sys.argv, "--placement", "collocated",
         choices=("collocated", "disagg"),
     )
+    # Traffic control: "on" fronts admission with the default
+    # SchedulerConfig (bounded queue, WFQ, preemption, degradation) so
+    # the SAME seeded schedule drives quota-on vs quota-off runs —
+    # e.g. `--preset overload --scheduler on` vs `--scheduler off`.
+    sched_arg = str_flag(
+        sys.argv, "--scheduler", "off", choices=("off", "on")
+    )
     out = str_flag(sys.argv, "--out", "")
     try:
         rates = [float(r) for r in rates_arg.split(",") if r]
@@ -418,6 +449,11 @@ def main() -> int:
             )
         from adapt_tpu.utils.profiling import global_engine_obs
 
+        scheduler = None
+        if sched_arg == "on":
+            from adapt_tpu.config import SchedulerConfig
+
+            scheduler = SchedulerConfig()
         if placement == "disagg":
             # Same schedule, disaggregated serving path (paged decode +
             # prefill tier) — the apples-to-apples arm of the
@@ -427,6 +463,7 @@ def main() -> int:
                 spec.prompt_max + spec.steps_max + 8,
                 slots,
                 chunk,
+                scheduler=scheduler,
             )
         else:
             bat = build_batcher(
@@ -435,6 +472,7 @@ def main() -> int:
                 slots,
                 chunk,
                 layout,
+                scheduler=scheduler,
             )
         # Phase timing on: every curve point gets its roofline
         # annotation (mbu/mfu need measured phase walls).
@@ -459,10 +497,13 @@ def main() -> int:
             "chunk": chunk,
             "layout": layout,
             "placement": placement,
+            "scheduler": sched_arg,
             "preset": preset_name or None,
             "spec": dataclasses.asdict(spec),
             "points": [
-                {k: v for k, v in p.items() if k != "token_counts"}
+                {k: v for k, v in p.items()
+                 if k not in ("token_counts", "request_ttfts",
+                              "rejected_flags")}
                 for p in points
             ],
         }

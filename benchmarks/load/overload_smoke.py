@@ -1,0 +1,270 @@
+"""Traffic-control A/B under 2x overload: quota-on vs quota-off, gated.
+
+The ``overload`` workload preset (``benchmarks/load/workload.PRESETS``)
+is a two-tenant priority mix — "free" floods (~89% of arrivals) at the
+ordinary class, "gold" is the protected ~11% minority in a strictly
+higher class with a 1 s TTFT budget. The offered rate is CALIBRATED
+per run: a saturating burst (every request submitted up front, run to
+drain) measures THIS box's capacity in tokens/s, and the schedule then
+offers exactly 2x it — on an idle CI container that lands near the
+preset's documented 960 rps (throughput plateaus ~9.5-10k tok/s), on
+a gate-loaded box proportionally lower. Calibration is what makes the
+gate portable: a fixed rate is 2x overload on the box it was measured
+on and 5-10x on a contended one, where even the protected tenant's own
+traffic exceeds total capacity and no scheduler could save it. Gold's
+~11% share keeps its offered load at ~0.22x capacity at the 2x point —
+protecting it is a SCHEDULING problem, never a capacity one.
+
+This driver runs the SAME calibrated, seeded schedule through two arms
+on identically-configured batchers and emits TWO gated records:
+
+- ``load_overload_hi_ttft_attainment`` — the fraction of GOLD requests
+  whose first token landed inside the TTFT budget under the
+  traffic-control tier (bounded ``AdmissionQueue`` + tenant quotas +
+  weighted fair queueing + decode-slot preemption). Rejected or
+  never-finished gold requests count as missed. The acceptance pin is
+  >= 0.9: the protected tenant stays inside budget while the system
+  is offered twice what it can serve. Per-request TTFTs are measured
+  DRIVER-side (submit wall -> first ``on_token``), so the per-tenant
+  split costs no registry cardinality.
+- ``load_overload_goodput_ratio`` — aggregate goodput (delivered
+  tokens inside budget / s), quota-on / quota-off. "Graceful
+  degradation" means protecting gold must not collapse the aggregate
+  BELOW the uncontrolled FIFO arm (which drowns: measured attainment
+  ~0.3-0.5, goodput well under the saturation plateau); shedding the
+  flood synchronously (``QueueFullError``) typically RAISES goodput,
+  because every admitted request is one the tier can still serve
+  inside budget.
+
+Structural checks become error records the gate always fails:
+- the quota-OFF control arm ALSO holding gold TTFT attainment >= 0.9
+  (the overload no longer overloads — the A/B discriminates nothing);
+- a quota-on arm that sheds nothing (no rejections) while the control
+  arm misses budgets — the bounded queue is not engaging.
+
+Usage: ``python benchmarks/load/overload_smoke.py [--seed 0]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks.common import emit, int_flag  # noqa: E402
+from benchmarks.load.workload import (  # noqa: E402
+    build_schedule,
+    offered_tokens,
+    preset,
+)
+
+DURATION_S = 2.0
+SLOTS = 4
+CHUNK = 8
+#: Calibration burst: this many requests (deterministic arrivals, the
+#: preset's length distributions) submitted up front and run to drain
+#: measure the box's capacity — ~6k tokens: <1s idle, a few seconds
+#: on a gate-loaded box.
+CALIBRATION_REQUESTS = 300
+#: The overload factor the A/B claims.
+OVERLOAD_X = 2.0
+
+_METRICS = (
+    ("load_overload_hi_ttft_attainment",
+     "gold-tenant TTFT attainment under 2x overload with the "
+     "traffic-control tier on"),
+    ("load_overload_goodput_ratio",
+     "aggregate goodput under 2x overload, quota-on / quota-off"),
+)
+
+
+def _emit_errors(err: str) -> None:
+    for metric, unit in _METRICS:
+        print(
+            json.dumps(
+                {"metric": metric, "value": 0.0, "unit": unit,
+                 "vs_baseline": 0.0, "error": err}
+            ),
+            flush=True,
+        )
+
+
+def _tenant_ttft_stats(schedule, report, tenant: str, budget: float):
+    """(attainment, p99_s, count) for one tenant from the driver-side
+    per-request TTFTs. A rejected request — or one that never emitted
+    (must not happen after drain, but counted defensively) — is a
+    miss: the client asked and was not served inside budget."""
+    ttfts = report["request_ttfts"]
+    rejected = report["rejected_flags"]
+    met = tot = 0
+    vals = []
+    for a, t, rej in zip(schedule, ttfts, rejected):
+        if a.tenant != tenant:
+            continue
+        tot += 1
+        if not rej and t is not None:
+            vals.append(t)
+            if t <= budget:
+                met += 1
+    att = met / tot if tot else 0.0
+    p99 = (
+        sorted(vals)[max(0, int(0.99 * len(vals)) - 1)] if vals else None
+    )
+    return att, p99, tot
+
+
+def main() -> int:
+    seed = int_flag(sys.argv, "--seed", 0)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import dataclasses
+        import time
+
+        import numpy as np
+
+        from benchmarks.load.harness import (
+            build_batcher,
+            drive_phase,
+            warmup,
+        )
+
+        from adapt_tpu.config import SchedulerConfig, TenantQuota
+
+        spec = preset("overload", duration_s=DURATION_S)
+        budget = spec.ttft_budget_s
+        max_len = spec.prompt_max + spec.steps_max + 8
+
+        # -- calibrate: measure THIS box's capacity, offer 2x it -----
+        # The control-arm batcher doubles as the calibration vehicle
+        # (same config, already warmed — no third compile set).
+        bat_off = build_batcher(spec.vocab, max_len, SLOTS, CHUNK)
+        warmup(bat_off, spec.vocab, spec.steps_max, spec.prompt_max)
+        burst = build_schedule(
+            dataclasses.replace(
+                spec, arrival="deterministic",
+                rate_rps=CALIBRATION_REQUESTS / DURATION_S,
+            ),
+            seed + 7,
+        )
+        t0 = time.perf_counter()
+        for a in burst:
+            bat_off.submit(np.asarray(a.prompt, np.int32), a.steps)
+        bat_off.run()
+        burst_wall = time.perf_counter() - t0
+        capacity_tok_s = offered_tokens(burst) / burst_wall
+        mean_steps = offered_tokens(burst) / len(burst)
+        rate = max(
+            50.0,
+            min(2000.0, OVERLOAD_X * capacity_tok_s / mean_steps),
+        )
+        spec = dataclasses.replace(spec, rate_rps=rate)
+        schedule = build_schedule(spec, seed)
+
+        # Quota-OFF control arm first (it is the calibration batcher):
+        # the pre-traffic-control FIFO — the default AdmissionQueue
+        # bound is far above this phase's backlog, so nothing rejects;
+        # admission is pure arrival order.
+        rep_off = drive_phase(bat_off, schedule, spec)
+        st_off = bat_off.stats()
+        bat_off.close()
+        # Quota-ON: the traffic-control tier. Gold in a strictly
+        # higher class (preset priorities) with 4x the DRR weight;
+        # the free flood is burst-capped so admitted free requests
+        # are ones the tier can still serve soon; preemption covers
+        # the window where every slot is held by a free decode.
+        sched_cfg = SchedulerConfig(
+            max_queue_depth=256,
+            quotas={
+                "gold": TenantQuota(weight=4.0),
+                "free": TenantQuota(weight=1.0, burst=16),
+            },
+            preempt=True,
+        )
+        bat_on = build_batcher(
+            spec.vocab, max_len, SLOTS, CHUNK, scheduler=sched_cfg
+        )
+        warmup(bat_on, spec.vocab, spec.steps_max, spec.prompt_max)
+        rep_on = drive_phase(bat_on, schedule, spec)
+        st_on = bat_on.stats()
+        bat_on.close()
+
+        att_on, p99_on, n_gold = _tenant_ttft_stats(
+            schedule, rep_on, "gold", budget
+        )
+        att_off, p99_off, _ = _tenant_ttft_stats(
+            schedule, rep_off, "gold", budget
+        )
+        goodput_on = rep_on["goodput_tokens_s"]
+        goodput_off = rep_off["goodput_tokens_s"]
+        ratio = goodput_on / goodput_off if goodput_off > 0 else 0.0
+
+        if att_off >= 0.9:
+            # The control arm also protected gold: the calibrated
+            # rate no longer overloads this config, so a quota-on
+            # pass proves nothing.
+            _emit_errors(
+                f"quota-off control arm also passes (gold attainment "
+                f"{att_off:.3f} >= 0.9 at the calibrated "
+                f"{rate:.0f} rps == {OVERLOAD_X}x measured capacity "
+                f"{capacity_tok_s:.0f} tok/s) — the A/B discriminates "
+                "nothing"
+            )
+            return 0
+        if st_on["rejected"] == 0 and st_on["preempted"] == 0:
+            _emit_errors(
+                "quota-on arm neither rejected nor preempted anything "
+                "under 2x overload while the control arm missed "
+                "budgets — the traffic-control tier is not engaging"
+            )
+            return 0
+
+        extras = {
+            "seed": seed,
+            "rate_rps": round(rate, 1),
+            "calibrated_capacity_tokens_s": round(capacity_tok_s, 1),
+            "overload_x": OVERLOAD_X,
+            "requests": rep_on["requests"],
+            "gold_requests": n_gold,
+            "ttft_budget_s": budget,
+            "gold_ttft_p99_s": p99_on,
+            "control_gold_ttft_attainment": round(att_off, 4),
+            "control_gold_ttft_p99_s": p99_off,
+            "rejected": st_on["rejected"],
+            "preempted": st_on["preempted"],
+            "offered_tokens_s": rep_on["offered_tokens_s"],
+            "goodput_on_tokens_s": goodput_on,
+            "goodput_off_tokens_s": goodput_off,
+            "slo_attainment_on": rep_on["slo_attainment"],
+            "slo_attainment_off": rep_off["slo_attainment"],
+            "per_tenant_on": rep_on["per_tenant"],
+            "per_tenant_off": rep_off["per_tenant"],
+            "schedule_digest": rep_on["schedule_digest"],
+        }
+        emit(
+            "load_overload_hi_ttft_attainment",
+            round(att_on, 4),
+            _METRICS[0][1],
+            round(att_on - 1.0, 4),
+            **extras,
+        )
+        emit(
+            "load_overload_goodput_ratio",
+            round(ratio, 4),
+            _METRICS[1][1],
+            round(ratio - 1.0, 4),
+            seed=seed,
+            goodput_on_tokens_s=goodput_on,
+            goodput_off_tokens_s=goodput_off,
+            rejected=st_on["rejected"],
+            preempted=st_on["preempted"],
+        )
+    except Exception as e:  # noqa: BLE001 — always JSON lines, rc 0
+        _emit_errors(str(e)[-300:])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

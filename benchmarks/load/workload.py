@@ -54,6 +54,11 @@ class WorkloadSpec:
     #: Tenant labels, Zipf-weighted by list rank (rank r ~ 1/r^skew).
     tenants: tuple[str, ...] = ("t0", "t1", "t2", "t3")
     tenant_skew: float = 1.5
+    #: Scheduling class per tenant (``config.SLOSpec.priority``;
+    #: unlisted tenants ride class 0). The traffic-control tier's
+    #: priority mixes come from here — e.g. the "overload" preset's
+    #: protected-gold / best-effort-flood split.
+    tenant_priorities: tuple[tuple[str, int], ...] = ()
     #: Per-request latency budgets (None disables that budget).
     ttft_budget_s: float | None = 1.0
     itl_budget_s: float | None = 0.5
@@ -104,6 +109,39 @@ PRESETS: dict[str, dict] = {
         ttft_budget_s=3.0,
         itl_budget_s=2.0,
     ),
+    # The OVERLOAD preset: 2x the measured saturation rate of the
+    # smoke-scale serving config (4 slots, chunk 8, tiny LM), with a
+    # TWO-TENANT PRIORITY MIX and heavy-tailed lengths: "free" floods
+    # (~89% of arrivals, Zipf rank 0 at skew 3) at the ordinary
+    # class, "gold" is the protected ~11% minority in a strictly
+    # higher class — small enough that gold's own offered load
+    # (~0.22x capacity at the 2x point) always fits, which is what
+    # makes "protect gold" a scheduling problem rather than a
+    # capacity one. Under FIFO this mix drowns gold's TTFT budget
+    # (queue wait at 2x overload grows past the 1s budget mid-phase);
+    # the traffic-control tier (quotas + WFQ + preemption) must keep
+    # gold inside budget while aggregate goodput degrades gracefully.
+    # rate_rps here is 2x the saturation measured on an IDLE CI
+    # container (throughput plateaus ~9.5-10k tok/s == ~480 rps) —
+    # the right default for manual `harness.py --preset overload`
+    # runs; benchmarks/load/overload_smoke.py instead CALIBRATES the
+    # rate per run (a saturating burst measures the box's actual
+    # capacity, then the schedule offers exactly 2x it), so the gate
+    # holds on loaded CI boxes where the idle number is 3-5x off.
+    "overload": dict(
+        rate_rps=960.0,
+        prompt_median=6,
+        prompt_sigma=0.8,
+        prompt_max=16,
+        steps_median=16,
+        steps_sigma=0.8,
+        steps_max=48,
+        tenants=("free", "gold"),
+        tenant_skew=3.0,
+        tenant_priorities=(("gold", 10),),
+        ttft_budget_s=1.0,
+        itl_budget_s=2.0,
+    ),
 }
 
 
@@ -129,6 +167,8 @@ class Arrival:
     tenant: str
     #: Driver cancels after this many emitted tokens (None = run out).
     cancel_after: int | None
+    #: Scheduling class (rides ``SLOSpec.priority`` at submit).
+    priority: int = 0
 
 
 def _lognormal_len(
@@ -160,6 +200,7 @@ def build_schedule(spec: WorkloadSpec, seed: int) -> list[Arrival]:
          for r in range(len(spec.tenants))]
     )
     weights /= weights.sum()
+    prio_map = dict(spec.tenant_priorities)
     out: list[Arrival] = []
     for t in times:
         plen = _lognormal_len(
@@ -191,6 +232,7 @@ def build_schedule(spec: WorkloadSpec, seed: int) -> list[Arrival]:
                 steps=steps,
                 tenant=tenant,
                 cancel_after=cancel_after,
+                priority=prio_map.get(tenant, 0),
             )
         )
     return out
@@ -204,7 +246,7 @@ def schedule_digest(schedule: list[Arrival]) -> str:
         h.update(
             repr(
                 (round(a.t, 9), a.prompt, a.steps, a.tenant,
-                 a.cancel_after)
+                 a.cancel_after, a.priority)
             ).encode()
         )
     return h.hexdigest()[:16]
