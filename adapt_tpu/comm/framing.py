@@ -66,6 +66,14 @@ MSG_ERROR = 5
 #: ONE namespace across both modules, so new types must collide with
 #: neither.)
 MSG_KV_PAGES = 18
+#: Telemetry federation report (``utils/telemetry``): payload is one
+#: JSON ``TelemetryReporter.collect()`` dict — windowed metric deltas,
+#: flight-event deltas, span exports — pushed periodically by a worker
+#: process to its parent; ``request_id`` carries the report's
+#: per-process sequence number. Next free value after MSG_KV_PAGES=18
+#: in the shared type-byte namespace (1-5 here, 6-17 in
+#: ``comm.remote``); the next new type is 20.
+MSG_TELEMETRY = 19
 
 #: header: type, stage_index (signed: canary probes use PING_STAGE = -1),
 #: request_id (signed: probe ids are negative, disjoint from requests),

@@ -41,6 +41,7 @@ from adapt_tpu.comm.framing import (
     MSG_DATA,
     MSG_ERROR,
     MSG_RESULT,
+    MSG_TELEMETRY,
     Message,
     payload_bytes,
     recv_msg,
@@ -51,7 +52,15 @@ from adapt_tpu.control.registry import WorkerRegistry
 from adapt_tpu.control.worker import TaskResult, WorkerState
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import global_metrics
-from adapt_tpu.utils.tracing import export_spans, global_tracer
+from adapt_tpu.utils.telemetry import (
+    TelemetryReporter,
+    global_federated_store,
+)
+from adapt_tpu.utils.tracing import (
+    export_spans,
+    global_flight_recorder,
+    global_tracer,
+)
 
 log = get_logger("remote")
 
@@ -124,17 +133,38 @@ class RemoteStageServer:
         heartbeat_s: float = 0.5,
         host: str = "127.0.0.1",
         allow_registry: bool = True,
+        telemetry_s: float = 2.0,
     ):
         """``allow_registry=False`` — serve ONLY architecture-by-value
         configures (``graph_spec`` in the header): the stance of a bare
         worker image that ships the framework but no model zoo
         (reference: any worker can ``model_from_json`` anything,
-        ``src/node.py:40-45``)."""
+        ``src/node.py:40-45``).
+
+        ``telemetry_s`` — cadence of telemetry-federation reports
+        (``MSG_TELEMETRY``: windowed metric deltas, flight events,
+        span exports) pushed on the DISPATCHER link's heartbeat
+        thread; 0 disables the push. Reports ride only the primary
+        (dispatcher) connection — chain-peer links would discard them
+        unread, and two links pushing would split the deltas."""
         self.port = port
         self.host = host
         self.device = jax.devices()[device_index]
         self.heartbeat_s = heartbeat_s
         self.allow_registry = allow_registry
+        self.telemetry_s = telemetry_s
+        #: How this process names itself in telemetry reports (the
+        #: dispatcher-side ingest overrides it with the lease's
+        #: worker id — a dial-out server only knows its port).
+        self.telemetry_worker = f"{host}:{port}"
+        self._telemetry: TelemetryReporter | None = None
+        #: Reports collected but not delivered (the link died between
+        #: collect and send): collect() CONSUMES its snapshot window,
+        #: so a dropped report would permanently lose that window's
+        #: deltas from the fleet totals. Bounded — a long outage
+        #: degrades to losing the oldest windows, loudly countable as
+        #: a seq gap on the parent, never unbounded memory here.
+        self._telemetry_backlog: list[tuple[int, bytes]] = []
         self._graph_cache: dict[str, Any] = {}
         self._stages: dict[int, tuple[Any, Any]] = {}  # idx -> (fn, vars)
         self._stage_gen: dict[int, int] = {}  # idx -> installing generation
@@ -304,6 +334,16 @@ class RemoteStageServer:
                 send_msg(conn, msg)
 
         def ping_loop():
+            # Telemetry cadence in heartbeat units (the push shares the
+            # ping thread so a wedged serve loop stops reporting — which
+            # is exactly the staleness signal the parent's
+            # fleet.report_age_s gauge surfaces).
+            every = (
+                max(1, round(self.telemetry_s / self.heartbeat_s))
+                if self.telemetry_s > 0
+                else 0
+            )
+            beats = 0
             while not stop_ping.wait(self.heartbeat_s):
                 if self._crashed:
                     return
@@ -311,6 +351,41 @@ class RemoteStageServer:
                     reply(Message(MSG_PING, 0, 0, 0, b""))
                 except OSError:
                     return
+                beats += 1
+                if not every or beats % every:
+                    continue
+                if self._primary_reply is not reply:
+                    continue  # only the dispatcher link carries reports
+                try:
+                    if self._telemetry is None:
+                        self._telemetry = TelemetryReporter(
+                            "stage", self.telemetry_worker
+                        )
+                    report = self._telemetry.collect()
+                    # default=str: a non-JSON value (numpy scalar in a
+                    # gauge or flight datum) degrades to its repr —
+                    # the same hazard rule the exporter's JSON
+                    # endpoints apply — instead of killing this
+                    # worker's telemetry forever.
+                    self._telemetry_backlog.append(
+                        (
+                            int(report["seq"]),
+                            json.dumps(report, default=str).encode(),
+                        )
+                    )
+                    del self._telemetry_backlog[:-8]
+                    # Oldest first (the store's seq-gap loss detector
+                    # relies on in-order arrival); a frame that fails
+                    # to send stays queued for the next beat or the
+                    # next dispatcher connection.
+                    while self._telemetry_backlog:
+                        seq, blob = self._telemetry_backlog[0]
+                        reply(Message(MSG_TELEMETRY, 0, seq, 0, blob))
+                        self._telemetry_backlog.pop(0)
+                except OSError:
+                    return
+                except Exception:  # noqa: BLE001 — telemetry must never
+                    log.exception("telemetry push failed")  # kill pings
 
         threading.Thread(target=ping_loop, daemon=True).start()
         # (stage, generation) -> {"cfg": dict, "arrays": {index: ndarray}}:
@@ -503,6 +578,7 @@ class RemoteStageServer:
             # Span tagged with the header's OWN request/attempt ids — the
             # key the dispatcher stitches this back into the originating
             # request's trace with (no side-channel correlation).
+            t_exec = time.perf_counter()
             with global_tracer().span(
                 "remote.stage_exec",
                 request=msg.request_id,
@@ -519,6 +595,21 @@ class RemoteStageServer:
                 # buffer views, never concatenated host-side (zero framing
                 # copies per hop).
                 out = codec_lib.pack_frames(self._codec, y)
+            # Worker-process telemetry: counters + an exec-wall
+            # histogram in THIS process's registry (federated to the
+            # dispatcher as MSG_TELEMETRY reports) and a flight edge
+            # naming the request — the worker's half of the
+            # /debug/request/<id> forensics story.
+            global_metrics().inc("remote.stage_execs")
+            global_metrics().observe(
+                "remote.stage_exec_s", time.perf_counter() - t_exec
+            )
+            global_flight_recorder().record(
+                "remote_exec",
+                request=msg.request_id,
+                stage=msg.stage_index,
+                attempt=msg.attempt,
+            )
             # Trace annex: this hop's span, appended to any spans already
             # riding the inbound frame (mid-chain hops accumulate, so the
             # tail result delivers the WHOLE chain's spans hub-ward).
@@ -645,6 +736,10 @@ class RemoteStageServer:
         attempt lands. A genuine rejection (bad secret, true duplicate)
         exhausts the budget and raises."""
         join_retries = 8
+        # Joiners DO know their fleet identity — name telemetry reports
+        # with it (dial-out servers fall back to host:port and let the
+        # proxy-side ingest rename them).
+        self.telemetry_worker = worker_id
         for join_attempt in range(join_retries):
             last: Exception | None = None
             for _ in range(retries):
@@ -1123,6 +1218,19 @@ class RemoteWorkerProxy:
                 self._registry.heartbeat(
                     self.worker_id, ttl_s=self._fault.lease_ttl_s
                 )
+            elif msg.msg_type == MSG_TELEMETRY:
+                # Fold the worker's report into the process-global
+                # federated store under THIS lease's worker id (the
+                # report only knows its port). Malformed reports are
+                # counted, never allowed to kill the read loop.
+                try:
+                    global_federated_store().ingest(
+                        json.loads(payload_bytes(msg.payload).decode()),
+                        worker=self.worker_id,
+                    )
+                    global_metrics().inc("fleet.reports_total")
+                except Exception:  # noqa: BLE001
+                    global_metrics().inc("fleet.report_rejected_total")
             elif msg.msg_type == MSG_PROBE_ACK:
                 self._results.put(
                     TaskResult(
@@ -1389,6 +1497,13 @@ def main() -> None:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--heartbeat", type=float, default=0.5)
     p.add_argument(
+        "--telemetry-s",
+        type=float,
+        default=2.0,
+        help="telemetry-federation report cadence on the dispatcher "
+        "link (seconds; 0 disables the push)",
+    )
+    p.add_argument(
         "--secret",
         default=os.environ.get("ADAPT_TPU_GATEWAY_SECRET"),
         help="gateway join secret (or env ADAPT_TPU_GATEWAY_SECRET)",
@@ -1408,6 +1523,7 @@ def main() -> None:
         heartbeat_s=args.heartbeat,
         host=args.host,
         allow_registry=not args.no_registry,
+        telemetry_s=args.telemetry_s,
     )
     if args.connect is not None:
         host, _, port = args.connect.rpartition(":")

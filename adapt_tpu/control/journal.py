@@ -291,6 +291,13 @@ class DispatcherJournal:
             meta = self._submit_meta.get(request_id)
             return dict(meta) if meta is not None else None
 
+    def pending_ids(self) -> set[int]:
+        """Ids submitted but never done-marked — what a recovery would
+        replay, and the forensics assembler's "still pending" bit
+        (``utils.telemetry.assemble_request``)."""
+        with self._lock:
+            return set(self._pending)
+
     def read_payload(self, request_id: int) -> np.ndarray:
         """Load one pending request's journaled payload (the replay
         source — raises ``OSError`` if the payload is gone)."""

@@ -136,6 +136,21 @@ class WorkerRegistry:
                 if exp > now and self._meta[w].get("role") is None
             ]
 
+    def alive_meta(self) -> dict[str, dict]:
+        """Every live lease's metadata in ONE lock hold
+        (``{worker_id: meta copy}``) — the telemetry federation
+        poller's scan (``utils.telemetry.FederatedStore.poll_registry``
+        reads each lease's ``meta["telemetry"]`` pull URL), and any
+        other reader that would otherwise pay a lock acquisition per
+        worker via :meth:`meta`."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                w: dict(self._meta[w])
+                for w, exp in self._leases.items()
+                if exp > now
+            }
+
     def role(self, worker_id: str) -> str | None:
         """The lease's ``meta["role"]`` tag (None = untagged)."""
         with self._lock:

@@ -2572,6 +2572,24 @@ class ContinuousBatcher:
         SLO-carry-across-lives discipline instead of inventing a
         second one."""
         req = slot.req
+        # Per-life timing stamps for the INTERRUPTED life, riding the
+        # replay/preemption flight edge (its finish event belongs to a
+        # later life whose clock starts mid-stream): TTFT only when
+        # this life emitted the request's true first token — the
+        # forensics bundle (utils.telemetry.assemble_request) reads
+        # each life's story straight off these edges.
+        life_stamps: dict = {}
+        if slot.t_first != 0.0:
+            if req.stream_skip == 0:
+                life_stamps["ttft_s"] = round(
+                    slot.t_first - req.t_submit, 6
+                )
+            if len(slot.tokens) > 1 and slot.t_last > slot.t_first:
+                life_stamps["life_itl_mean_s"] = round(
+                    (slot.t_last - slot.t_first)
+                    / (len(slot.tokens) - 1),
+                    6,
+                )
         # Tokens already DELIVERED to the client across this request's
         # lives (a double-kill chain replays a replay: slot.tokens
         # restarts at 0 each life, so the high-water mark carries).
@@ -2640,6 +2658,7 @@ class ContinuousBatcher:
             slot=slot.idx,
             source=source,
             tokens_discarded=len(slot.tokens),
+            **life_stamps,
             **(extra or {}),
         )
         with self._cv:
@@ -2829,11 +2848,32 @@ class ContinuousBatcher:
         # Flight events stay UNGATED like cancel's: the recorder's
         # contract is always-on per-lifecycle — a post-mortem must not
         # show cancels for requests with no admit/finish.
+        # Per-life timing stamps ride the finish edge when the timeline
+        # stamped them (obs_timeline): the per-request forensics bundle
+        # (utils.telemetry.assemble_request, GET /debug/request/<id>)
+        # reads TTFT and this life's mean inter-token gap straight off
+        # the flight stream instead of reverse-engineering them from
+        # process-wide histograms.
+        stamps: dict = {}
+        if slot.t_first != 0.0:
+            if req.stream_skip == 0:
+                stamps["ttft_s"] = round(
+                    slot.t_first - req.t_submit, 6
+                )
+            if len(slot.tokens) > 1 and slot.t_last > slot.t_first:
+                stamps["life_itl_mean_s"] = round(
+                    (slot.t_last - slot.t_first)
+                    / (len(slot.tokens) - 1),
+                    6,
+                )
+        if req.t_requeued:
+            stamps["replayed_life"] = True
         global_flight_recorder().record(
             "finish",
             request=req.req_id,
             reason=reason,
             tokens=len(toks),
+            **stamps,
         )
         with self._cv:
             self._done[req.req_id] = toks

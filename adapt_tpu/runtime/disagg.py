@@ -606,6 +606,7 @@ class DisaggServer:
         config: DisaggConfig | None = None,
         registry=None,
         lease_ttl_s: float = 2.0,
+        telemetry_url: str | None = None,
     ):
         if not decode._paged:
             raise ValueError(
@@ -639,13 +640,23 @@ class DisaggServer:
         self._registry = registry
         self._lease_ttl = lease_ttl_s
         self._lease_key = f"prefill:{prefill.name}"
+        #: Lease metadata. ``telemetry_url`` (the tier's exporter
+        #: ``/telemetry.json``) advertises the HTTP-PULL federation
+        #: fallback: a dispatcher that does not own this process's
+        #: comm link discovers the endpoint off the lease and polls it
+        #: (``utils.telemetry.FederatedStore.poll_registry``) — the
+        #: lease is the membership record, so it is also the telemetry
+        #: directory.
+        self._lease_meta = {"role": "prefill"}
+        if telemetry_url is not None:
+            self._lease_meta["telemetry"] = telemetry_url
         if registry is not None:
             # ROLE-TAGGED lease: the pipeline dispatcher's _acquire
             # skips role-tagged workers, and this policy stops routing
             # to the tier when the lease expires (alive(role=)).
             self._lease_token = registry.register(
                 self._lease_key,
-                meta={"role": "prefill"},
+                meta=dict(self._lease_meta),
                 ttl_s=lease_ttl_s,
             )
         #: Drain switch (close()): stops lease keepalive/resurrection
@@ -917,7 +928,7 @@ class DisaggServer:
             # server never resurrects its lease.
             self._lease_token = self._registry.register(
                 self._lease_key,
-                meta={"role": "prefill"},
+                meta=dict(self._lease_meta),
                 ttl_s=self._lease_ttl,
             )
         for handoff in self.prefill.step():

@@ -440,12 +440,26 @@ class ServingPipeline:
 
     def serve_observability(self, port: int = 0, host: str = "127.0.0.1"):
         """Start the process observability exporter (``/metrics``,
-        ``/trace.json``, ``/debug/events`` — see ``utils.exporter``) on
-        a daemon thread; returns the HTTP server (``.server_address[1]``
-        is the bound port; ``port=0`` picks a free one). The endpoints
-        cover everything in this process: this pipeline's dispatcher and
-        workers, any ContinuousBatcher, the tracer ring and the flight
-        recorder."""
+        ``/trace.json``, ``/debug/events``, the ``/fleet/*``
+        federation views and ``/debug/request/<id>`` forensics — see
+        ``utils.exporter``) on a daemon thread; returns the HTTP
+        server (``.server_address[1]`` is the bound port; ``port=0``
+        picks a free one). The endpoints cover everything in this
+        process — this pipeline's dispatcher and workers, any
+        ContinuousBatcher, the tracer ring, the flight recorder —
+        plus every remote worker pushing telemetry reports to this
+        process's proxies. The dispatcher's journal (when configured)
+        feeds the forensics bundle's submit-meta section, and the
+        worker registry is scanned for lease-advertised HTTP-pull
+        telemetry endpoints."""
         from adapt_tpu.utils.exporter import serve_metrics
+        from adapt_tpu.utils.telemetry import global_federated_store
 
-        return serve_metrics(port=port, host=host)
+        global_federated_store().attach_registry(
+            self.dispatcher.registry
+        )
+        return serve_metrics(
+            port=port,
+            host=host,
+            journal=getattr(self.dispatcher, "_journal", None),
+        )

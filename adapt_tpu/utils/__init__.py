@@ -10,6 +10,12 @@ from adapt_tpu.utils.profiling import (
     register_memory_source,
     unregister_memory_source,
 )
+from adapt_tpu.utils.telemetry import (
+    FederatedStore,
+    TelemetryReporter,
+    assemble_request,
+    global_federated_store,
+)
 from adapt_tpu.utils.tracing import (
     FlightRecorder,
     Tracer,
@@ -34,4 +40,8 @@ __all__ = [
     "global_engine_obs",
     "register_memory_source",
     "unregister_memory_source",
+    "FederatedStore",
+    "TelemetryReporter",
+    "assemble_request",
+    "global_federated_store",
 ]

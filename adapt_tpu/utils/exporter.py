@@ -1,4 +1,5 @@
-"""HTTP observability exporter: metrics, traces, flight-recorder events.
+"""HTTP observability exporter: metrics, traces, flight-recorder
+events, fleet federation, per-request forensics.
 
 The observability surface SURVEY.md §5 calls for, made scrapeable: a
 stdlib ``ThreadingHTTPServer`` serving
@@ -7,16 +8,35 @@ stdlib ``ThreadingHTTPServer`` serving
   ``# TYPE`` lines (counters as ``adapt_<name>_total``, gauges as
   ``adapt_<name>``, histograms as a ``summary`` family of ``_count`` /
   ``_sum`` plus p50/p99 gauges; dots in metric names become
-  underscores),
-- ``GET /metrics.json`` — the raw :meth:`MetricsRegistry.snapshot`,
+  underscores). Known dynamic name suffixes — per-tenant
+  ``scheduler.queue_depth.<tenant>`` / ``slo.*_total.<tenant>``,
+  per-source ``fleet.report_age_s.<source>`` — render as Prometheus
+  LABELS (``adapt_scheduler_queue_depth{tenant="gold"}``), not baked
+  into the metric name,
+- ``GET /metrics.json`` — the raw :meth:`MetricsRegistry.snapshot`
+  (non-finite floats sanitized to ``null`` — a NaN roofline gauge must
+  not make the endpoint emit invalid JSON),
 - ``GET /trace.json`` — the :class:`~adapt_tpu.utils.tracing.Tracer`
-  ring as Chrome trace-event JSON: save it (or fetch it with curl) and
-  open in https://ui.perfetto.dev or ``chrome://tracing`` to see the
-  serving timeline — per-stage spans, hop/compute overlap, and remote
-  workers' stitched spans on their own process rows,
-- ``GET /debug/events`` — the flight recorder's structured event ring
-  (admissions, re-dispatches, quarantines, probe misses, recoveries),
-- ``GET /healthz`` — ``{"ok": true}`` liveness.
+  ring as Chrome trace-event JSON (Perfetto / ``chrome://tracing``),
+- ``GET /debug/events`` — the flight recorder's structured event ring,
+- ``GET /debug/request/<id>`` — per-request FORENSICS: one bundle
+  assembling the request's complete story across every federated
+  source (``utils.telemetry.assemble_request``),
+- ``GET /fleet/metrics`` / ``/fleet/metrics.json`` — the
+  :class:`~adapt_tpu.utils.telemetry.FederatedStore` merged across
+  every reporting process, Prometheus samples labeled
+  ``role``/``worker``, fleet histogram percentiles merged from the
+  sources' shipped reservoirs,
+- ``GET /fleet/events`` — the merged, wall-clock-ordered flight
+  stream across sources,
+- ``GET /telemetry.json`` — this process's own
+  ``TelemetryReporter.collect()`` body: the HTTP-PULL federation
+  fallback for processes the dispatcher has no comm link to (advertise
+  the URL in the worker's registry lease ``meta["telemetry"]``; one
+  puller per endpoint — each GET returns the delta since the last),
+- ``GET /healthz`` — ``{"ok": true, "pid": ..., "role": ...,
+  "uptime_s": ...}`` liveness (the fields fleet liveness checks key
+  on).
 
 Serving-side components (dispatcher, continuous batcher, gateway) all
 write the shared :func:`adapt_tpu.utils.metrics.global_metrics`
@@ -31,12 +51,21 @@ No reference analog: the reference's only telemetry is ``print()``
 from __future__ import annotations
 
 import json
+import math
+import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from adapt_tpu.utils.logging import get_logger
 from adapt_tpu.utils.metrics import MetricsRegistry, global_metrics
+from adapt_tpu.utils.telemetry import (
+    FederatedStore,
+    TelemetryReporter,
+    assemble_request,
+    global_federated_store,
+)
 from adapt_tpu.utils.tracing import (
     FlightRecorder,
     Tracer,
@@ -48,44 +77,252 @@ log = get_logger("exporter")
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
+#: Dotted-name families whose LAST component is a dynamic value
+#: (tenant label, federation source key), not part of the metric's
+#: identity. Baking the value into the Prometheus name
+#: (``adapt_scheduler_queue_depth_gold``) makes every tenant a new
+#: metric no dashboard can aggregate; these render as labels instead.
+_LABEL_RULES: tuple[tuple[str, str], ...] = (
+    ("scheduler.queue_depth.", "tenant"),
+    ("slo.met_total.", "tenant"),
+    ("slo.missed_total.", "tenant"),
+    ("fleet.report_age_s.", "source"),
+    ("fleet.events_lost.", "source"),
+    ("fleet.reports_lost.", "source"),
+)
+
 
 def _prom_name(name: str) -> str:
     return "adapt_" + _NAME_RE.sub("_", name)
 
 
-def _family(lines: list[str], name: str, mtype: str, help_: str) -> None:
-    lines.append(f"# HELP {name} {help_}")
-    lines.append(f"# TYPE {name} {mtype}")
+def _counter_name(base: str) -> str:
+    """Counter family name: ``_total`` appended per convention, but
+    never doubled for dotted names that already end in ``.total`` /
+    ``_total`` (``slo.met_total`` must render ``adapt_slo_met_total``,
+    not ``..._total_total``)."""
+    pname = _prom_name(base)
+    return pname if pname.endswith("_total") else pname + "_total"
 
 
-def prometheus_text(snapshot: dict) -> str:
+def _split_labels(name: str) -> tuple[str, dict[str, str]]:
+    """``scheduler.queue_depth.gold`` ->
+    ``("scheduler.queue_depth", {"tenant": "gold"})``; unknown names
+    pass through with no labels."""
+    for prefix, label in _LABEL_RULES:
+        if name.startswith(prefix) and len(name) > len(prefix):
+            return name[: len(prefix) - 1], {label: name[len(prefix):]}
+    return name, {}
+
+
+def _esc_label(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_esc_label(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+class _PromDoc:
+    """Accumulates samples grouped by family so ``# HELP``/``# TYPE``
+    emit exactly once per family however many label combinations
+    sample it (the exposition-format contract scrapers parse by)."""
+
+    def __init__(self):
+        #: family name -> (mtype, help, [sample lines])
+        self._fams: dict[str, tuple[str, str, list[str]]] = {}
+
+    def add(
+        self,
+        fname: str,
+        mtype: str,
+        help_: str,
+        value,
+        labels: dict[str, str] | None = None,
+        sample: str | None = None,
+    ) -> None:
+        """One sample under family ``fname``. ``sample`` overrides the
+        sample line's metric name (summary families emit
+        ``<family>_count`` / ``<family>_sum`` under the family's own
+        HELP/TYPE, per the exposition format)."""
+        fam = self._fams.get(fname)
+        if fam is None:
+            fam = self._fams[fname] = (mtype, help_, [])
+        if isinstance(value, float) and not math.isfinite(value):
+            value = "NaN" if math.isnan(value) else (
+                "+Inf" if value > 0 else "-Inf"
+            )  # the text format HAS a spelling for these; JSON doesn't
+        fam[2].append(
+            f"{sample or fname}{_fmt_labels(labels or {})} {value}"
+        )
+
+    def render(self) -> str:
+        lines: list[str] = []
+        for fname in sorted(self._fams):
+            mtype, help_, samples = self._fams[fname]
+            lines.append(f"# HELP {fname} {help_}")
+            lines.append(f"# TYPE {fname} {mtype}")
+            lines.extend(samples)
+        return "\n".join(lines) + "\n"
+
+
+def prometheus_text(
+    snapshot: dict, const_labels: dict[str, str] | None = None
+) -> str:
     """Render a :meth:`MetricsRegistry.snapshot` in the Prometheus text
     exposition format. Every sample family gets ``# HELP``/``# TYPE``
-    lines (scrapers and promtool-style parsers want them); histograms
-    render as a ``summary`` family (count/sum) plus percentile gauges —
-    enough for dashboards without native histogram buckets."""
-    lines: list[str] = []
+    lines exactly once; histograms render as a ``summary`` family
+    (count/sum) plus percentile gauges. Known dynamic suffixes become
+    labels (see ``_LABEL_RULES``); ``const_labels`` (the fleet view's
+    ``role``/``worker``) attach to every sample."""
+    doc = _PromDoc()
+    base_labels = dict(const_labels or {})
     for name, value in sorted(snapshot.get("counters", {}).items()):
-        pname = _prom_name(name) + "_total"
-        _family(lines, pname, "counter", f"cumulative count of {name}")
-        lines.append(f"{pname} {value}")
+        base, labels = _split_labels(name)
+        doc.add(
+            _counter_name(base),
+            "counter",
+            f"cumulative count of {base}",
+            value,
+            {**base_labels, **labels},
+        )
     for name, value in sorted(snapshot.get("gauges", {}).items()):
-        pname = _prom_name(name)
-        _family(lines, pname, "gauge", f"current value of {name}")
-        lines.append(f"{pname} {value}")
+        base, labels = _split_labels(name)
+        doc.add(
+            _prom_name(base),
+            "gauge",
+            f"current value of {base}",
+            value,
+            {**base_labels, **labels},
+        )
     for name, summ in sorted(snapshot.get("histograms", {}).items()):
-        base = _prom_name(name)
-        _family(lines, base, "summary", f"distribution of {name}")
-        lines.append(f"{base}_count {summ.get('count', 0)}")
+        base, labels = _split_labels(name)
+        pname = _prom_name(base)
+        lab = {**base_labels, **labels}
+        help_ = f"distribution of {base}"
+        doc.add(
+            pname, "summary", help_, summ.get("count", 0), lab,
+            sample=pname + "_count",
+        )
         if summ.get("count"):
-            lines.append(f"{base}_sum {summ['sum']}")
+            doc.add(
+                pname, "summary", help_, summ["sum"], lab,
+                sample=pname + "_sum",
+            )
             for p in ("p50", "p99"):
-                pname = f"{base}_{p}"
-                _family(
-                    lines, pname, "gauge", f"{p} of {name} (reservoir)"
+                if p in summ:
+                    doc.add(
+                        f"{pname}_{p}",
+                        "gauge",
+                        f"{p} of {base} (reservoir)",
+                        summ[p],
+                        lab,
+                    )
+    return doc.render()
+
+
+def fleet_prometheus_text(fleet: dict) -> str:
+    """Render a :meth:`FederatedStore.fleet_snapshot` as ONE
+    Prometheus document: every source's counters/gauges/histogram
+    count+sum labeled ``role``/``worker``/``pid``, per-source
+    percentile gauges labeled the same, MERGED fleet percentiles (the
+    union-of-reservoirs numbers) as the unlabeled series, and the
+    staleness block as ``adapt_fleet_report_age_s{source=...}``."""
+    doc = _PromDoc()
+    for key, src in sorted(fleet.get("sources", {}).items()):
+        lab = {
+            "role": src["role"],
+            "worker": src["worker"],
+            "pid": str(src["pid"]),
+        }
+        for name, value in sorted(src.get("counters", {}).items()):
+            base, extra = _split_labels(name)
+            doc.add(
+                _counter_name(base), "counter",
+                f"cumulative count of {base} (federated)",
+                value, {**lab, **extra},
+            )
+        for name, value in sorted(src.get("gauges", {}).items()):
+            base, extra = _split_labels(name)
+            doc.add(
+                _prom_name(base), "gauge",
+                f"current value of {base} (federated)",
+                value, {**lab, **extra},
+            )
+        for name, summ in sorted(src.get("histograms", {}).items()):
+            base, extra = _split_labels(name)
+            pname = _prom_name(base)
+            hl = {**lab, **extra}
+            help_ = f"distribution of {base} (federated)"
+            doc.add(
+                pname, "summary", help_, summ.get("count", 0), hl,
+                sample=pname + "_count",
+            )
+            doc.add(
+                pname, "summary", help_, summ.get("sum", 0.0), hl,
+                sample=pname + "_sum",
+            )
+            for p in ("p50", "p99"):
+                if p in summ:
+                    doc.add(
+                        f"{pname}_{p}", "gauge",
+                        f"{p} of {base} (per-source reservoir)",
+                        summ[p], hl,
+                    )
+    merged = fleet.get("merged", {})
+    for name, summ in sorted(merged.get("histograms", {}).items()):
+        base, _ = _split_labels(name)
+        pname = _prom_name(base)
+        for p in ("p50", "p99"):
+            if p in summ:
+                doc.add(
+                    f"{pname}_{p}", "gauge",
+                    f"{p} of {base} (fleet-merged reservoirs)",
+                    summ[p], None,
                 )
-                lines.append(f"{pname} {summ[p]}")
-    return "\n".join(lines) + "\n"
+    for key, age in sorted(fleet.get("staleness", {}).items()):
+        doc.add(
+            "adapt_fleet_report_age_s", "gauge",
+            "seconds since each source's last telemetry report "
+            "(a growing age = a wedged or dead source)",
+            age, {"source": key},
+        )
+    doc.add(
+        "adapt_fleet_sources", "gauge",
+        "telemetry sources currently known to the federated store",
+        len(fleet.get("sources", {})), None,
+    )
+    return doc.render()
+
+
+def _sanitize(obj):
+    """Recursively replace non-finite floats with None: ``json.dumps``
+    spells them ``NaN``/``Infinity``, which is NOT JSON — one bad
+    roofline gauge on an odd backend must not make every
+    ``/metrics.json`` consumer's parser throw."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    return obj
+
+
+def _json_bytes(obj) -> bytes:
+    # default=str: one non-JSON value (numpy scalar, exception object)
+    # must degrade to its repr, not turn the scrape into a 500.
+    return json.dumps(_sanitize(obj), default=str).encode()
 
 
 def serve_metrics(
@@ -94,15 +331,27 @@ def serve_metrics(
     registry: MetricsRegistry | None = None,
     tracer: Tracer | None = None,
     recorder: FlightRecorder | None = None,
+    store: FederatedStore | None = None,
+    role: str = "server",
+    worker: str | None = None,
+    journal=None,
 ) -> ThreadingHTTPServer:
     """Start the exporter on a daemon thread; returns the server
     (``.server_address[1]`` is the bound port). Stop with
     ``.shutdown()`` then ``.server_close()`` — shutdown alone stops the
     loop but leaks the listening socket. ``registry``/``tracer``/
-    ``recorder`` default to the process-global ones."""
+    ``recorder``/``store`` default to the process-global ones.
+
+    ``role``/``worker`` name this process in the fleet views (and
+    ``/healthz``); the process registers itself as a LOCAL federation
+    source, so ``/fleet/*`` always includes the serving process's own
+    telemetry next to its workers'. ``journal`` (a
+    ``control.journal.DispatcherJournal``) enriches
+    ``/debug/request/<id>`` with submit metadata."""
     reg = registry if registry is not None else global_metrics()
     tr = tracer if tracer is not None else global_tracer()
     rec = recorder if recorder is not None else global_flight_recorder()
+    fed = store if store is not None else global_federated_store()
     # Pull-side bridges: codec registers its copy-stats collector on the
     # GLOBAL registry at import; re-register it on the registry actually
     # being served, so custom-registry exporters (tests, multi-tenant
@@ -118,29 +367,90 @@ def serve_metrics(
     from adapt_tpu.utils.profiling import engine_collector
 
     reg.register_collector(engine_collector)
+    # Federation bridges: this process is itself a fleet source, and
+    # the staleness gauges (fleet.report_age_s.<source>) land on the
+    # served registry so a plain /metrics scrape sees a wedged worker.
+    fed.attach_local(
+        role, worker, registry=reg, recorder=rec, tracer=tr
+    )
+    reg.register_collector(fed.collector)
+    if journal is not None:
+        fed.attach_journal(journal)
+    #: One pull-fallback reporter for /telemetry.json — independent of
+    #: the local-source reporter above (each keeps its own window and
+    #: cursors, so the two consumers don't split each other's deltas).
+    pull_reporter = TelemetryReporter(
+        role,
+        worker if worker is not None else f"pid{os.getpid()}",
+        registry=reg,
+        recorder=rec,
+        tracer=tr,
+    )
+    t_start = time.monotonic()
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 — http.server API
+            try:
+                self._serve()
+            except (BrokenPipeError, ConnectionResetError):
+                # A scraper hanging up mid-body is the CLIENT's
+                # problem; tracebacks per disconnect would spam the
+                # serving process's stderr under flaky collectors.
+                pass
+
+        def _serve(self):
             path = self.path.split("?", 1)[0]
             if path == "/metrics":
                 body = prometheus_text(reg.snapshot()).encode()
                 ctype = "text/plain; version=0.0.4"
             elif path == "/metrics.json":
-                body = json.dumps(reg.snapshot()).encode()
+                body = _json_bytes(reg.snapshot())
                 ctype = "application/json"
             elif path == "/trace.json":
-                # default=str: one non-JSON span attr / event value
-                # (numpy scalar, exception object) must degrade to its
-                # repr, not turn every scrape into a 500.
-                body = json.dumps(
-                    tr.to_chrome_trace(), default=str
-                ).encode()
+                body = _json_bytes(tr.to_chrome_trace())
                 ctype = "application/json"
             elif path == "/debug/events":
-                body = json.dumps(rec.snapshot(), default=str).encode()
+                body = _json_bytes(rec.snapshot())
+                ctype = "application/json"
+            elif path.startswith("/debug/request/"):
+                try:
+                    rid = int(path.rsplit("/", 1)[1])
+                except ValueError:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = _json_bytes(
+                    assemble_request(
+                        rid, store=fed, tracer=tr, journal=journal
+                    )
+                )
+                ctype = "application/json"
+            elif path == "/fleet/metrics":
+                body = fleet_prometheus_text(
+                    fed.fleet_snapshot()
+                ).encode()
+                ctype = "text/plain; version=0.0.4"
+            elif path == "/fleet/metrics.json":
+                body = _json_bytes(fed.fleet_snapshot())
+                ctype = "application/json"
+            elif path == "/fleet/events":
+                fed.refresh()
+                body = _json_bytes({"events": fed.events()})
+                ctype = "application/json"
+            elif path == "/telemetry.json":
+                body = _json_bytes(pull_reporter.collect())
                 ctype = "application/json"
             elif path == "/healthz":
-                body = b'{"ok": true}'
+                body = _json_bytes(
+                    {
+                        "ok": True,
+                        "pid": os.getpid(),
+                        "role": role,
+                        "uptime_s": round(
+                            time.monotonic() - t_start, 3
+                        ),
+                    }
+                )
                 ctype = "application/json"
             else:
                 self.send_response(404)
@@ -156,6 +466,19 @@ def serve_metrics(
             pass
 
     server = ThreadingHTTPServer((host, port), Handler)
+    # server_close() also retires the pull reporter: its chained
+    # snapshot window must not outlive the endpoint that drives it
+    # (an orphaned window taxes every later observe() and can evict a
+    # live load-harness phase window at _MAX_WINDOWS). The store's
+    # local-source reporter is shared store state — the store's own
+    # close() owns that one.
+    orig_close = server.server_close
+
+    def _close():
+        orig_close()
+        pull_reporter.close()
+
+    server.server_close = _close
     thread = threading.Thread(
         target=server.serve_forever, name="metrics-exporter", daemon=True
     )

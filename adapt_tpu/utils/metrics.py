@@ -89,7 +89,7 @@ class _Histogram:
         idx = min(len(s) - 1, int(p / 100.0 * len(s)))
         return s[idx]
 
-    def summary(self) -> dict:
+    def summary(self, reservoir: bool = False) -> dict:
         if self.count == 0:
             return {"count": 0}
         # ONE sort for every percentile: summary() runs under the
@@ -100,7 +100,7 @@ class _Histogram:
         def pct(p):
             return s[min(len(s) - 1, int(p / 100.0 * len(s)))]
 
-        return {
+        out = {
             "count": self.count,
             "sum": self.total,
             "mean": self.total / self.count,
@@ -109,6 +109,17 @@ class _Histogram:
             "p50": pct(50),
             "p99": pct(99),
         }
+        if reservoir:
+            # The raw decimating reservoir (every sample stands for
+            # ``stride`` observations) — what lets ANOTHER process
+            # merge this histogram's percentiles with its own honestly
+            # (utils.telemetry federation) instead of averaging
+            # pre-computed p99s, which has no meaning.
+            out["reservoir"] = {
+                "samples": list(self._samples),
+                "stride": self._stride,
+            }
+        return out
 
 
 class MetricsRegistry:
@@ -189,7 +200,11 @@ class MetricsRegistry:
                 self._collectors.append(fn)
 
     def snapshot(
-        self, *, window: bool = False, since: dict | None = None
+        self,
+        *,
+        window: bool = False,
+        since: dict | None = None,
+        reservoirs: bool = False,
     ) -> dict:
         """Point-in-time view of every metric.
 
@@ -216,7 +231,13 @@ class MetricsRegistry:
         ``ValueError`` for the latter and degrades to cumulative
         summaries flagged ``"window_evicted": True`` for the former —
         a load sweep must notice, not silently report boot-cumulative
-        percentiles as a phase's."""
+        percentiles as a phase's.
+
+        ``reservoirs=True`` adds each histogram summary's raw
+        decimating reservoir (``{"samples", "stride"}``) — the
+        serialized form the telemetry federation layer ships so fleet
+        percentiles merge from real samples, not from other
+        processes' pre-computed percentiles."""
         with self._lock:
             collectors = list(self._collectors)
         for fn in collectors:
@@ -234,7 +255,8 @@ class MetricsRegistry:
             if since is None:
                 out["counters"] = dict(self._counters)
                 out["histograms"] = {
-                    k: h.summary() for k, h in self._histograms.items()
+                    k: h.summary(reservoir=reservoirs)
+                    for k, h in self._histograms.items()
                 }
             else:
                 prev_counters = since.get("counters", {})
@@ -246,13 +268,14 @@ class MetricsRegistry:
                 forks = self._windows.pop(since["window"], None)
                 if forks is None:
                     out["histograms"] = {
-                        k: h.summary()
+                        k: h.summary(reservoir=reservoirs)
                         for k, h in self._histograms.items()
                     }
                     out["window_evicted"] = True
                 else:
                     out["histograms"] = {
-                        k: f.summary() for k, f in forks.items()
+                        k: f.summary(reservoir=reservoirs)
+                        for k, f in forks.items()
                     }
                 out["window_s"] = time.monotonic() - since["_t"]
             if window:

@@ -67,6 +67,11 @@ class Span:
     tid: int = 0
     #: Origin process; None = the owning tracer's process.
     pid: int | None = None
+    #: Per-tracer monotonic record number (assigned at ``_record``):
+    #: the cursor :meth:`Tracer.spans_since` pages the ring with, so
+    #: the telemetry reporter ships each span exactly once even while
+    #: the ring keeps evicting.
+    seq: int = 0
 
     @property
     def duration(self) -> float:
@@ -86,6 +91,7 @@ class Tracer:
         self.enabled = False
         self.spans_dropped = 0
         self.pid = os.getpid()
+        self._seq = 0  # monotonic record counter (spans_since cursor)
 
     def set_capacity(self, capacity: int) -> None:
         """Resize the ring, keeping the newest spans. No-op when the
@@ -98,6 +104,8 @@ class Tracer:
 
     def _record(self, s: Span) -> None:
         with self._lock:
+            self._seq += 1
+            s.seq = self._seq
             if len(self._spans) == self._capacity:
                 # deque(maxlen) evicts the oldest on append — a RING, not
                 # the old fill-once-then-drop-everything list. Count the
@@ -199,6 +207,23 @@ class Tracer:
             return [
                 s for s in self._spans if name is None or s.name == name
             ]
+
+    def spans_since(self, seq: int) -> tuple[list[Span], int]:
+        """Spans recorded after cursor ``seq`` (oldest first) plus the
+        new cursor — the telemetry reporter's incremental read.
+        Ring-eviction-safe: a span that fell out of the ring before a
+        read is simply gone (``spans_dropped`` counts it); the cursor
+        never re-delivers or skips survivors. Locally-recorded spans
+        only — remote-ingested spans (``pid`` set) are the OTHER
+        process's to report, and forwarding them would duplicate every
+        span once per federation hop."""
+        with self._lock:
+            out = [
+                s
+                for s in self._spans
+                if s.seq > seq and s.pid is None
+            ]
+            return out, self._seq
 
     def clear(self) -> None:
         with self._lock:
@@ -304,6 +329,13 @@ class FlightRecorder:
         #: lifecycle-edge accounting (every admit has a finish/cancel)
         #: stays checkable after a storm overflows the ring.
         self._kind_counts: collections.Counter = collections.Counter()
+        #: Per-process monotonic event number, stamped into every
+        #: event as ``"seq"``: the :meth:`events_since` cursor, and —
+        #: once events federate across processes (utils.telemetry) —
+        #: what lets the merged stream detect per-source loss (a seq
+        #: gap = events evicted before they shipped) instead of
+        #: silently presenting a holey timeline as complete.
+        self._seq = 0
 
     def set_capacity(self, capacity: int) -> None:
         if capacity == self._capacity:
@@ -319,6 +351,8 @@ class FlightRecorder:
             return
         ev = {"ts": time.time(), "kind": kind, "data": data}
         with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
             if len(self._events) == self._capacity:
                 self.events_dropped += 1
             self._events.append(ev)
@@ -329,6 +363,18 @@ class FlightRecorder:
             return [
                 e for e in self._events if kind is None or e["kind"] == kind
             ]
+
+    def events_since(self, seq: int) -> tuple[list[dict], int]:
+        """Events recorded after cursor ``seq`` (oldest first) plus
+        the new cursor — the telemetry reporter's incremental read.
+        Events evicted from the ring before a read are lost to the
+        stream (the receiver sees the seq gap); the cursor never
+        re-delivers a survivor."""
+        with self._lock:
+            return (
+                [e for e in self._events if e["seq"] > seq],
+                self._seq,
+            )
 
     def kind_counts(self) -> dict[str, int]:
         """Lifetime event count per kind, INDEPENDENT of ring eviction:

@@ -268,6 +268,19 @@ def drive_phase(
     decode_tokens_s = round(
         c.get("continuous.tokens_total", 0.0) / window_s, 2
     )
+    # Prefill-TIER telemetry (disagg arms): the windowed disagg.*
+    # counter deltas + handoff-wall percentiles, so a disagg phase
+    # report carries the tier's own numbers (placement split, pages
+    # and bytes streamed, failed handoffs) next to the decode-side
+    # stall histogram it exists to shrink — instead of reporting the
+    # stall win with the tier that produced it invisible.
+    disagg = {
+        k[len("disagg."):]: round(v, 3)
+        for k, v in c.items()
+        if k.startswith("disagg.") and v
+    }
+    if disagg:
+        disagg["handoff_s"] = pct("disagg.handoff_s")
     return {
         "requests": n,
         "offered_rps": round(n / spec.duration_s, 4),
@@ -306,6 +319,7 @@ def drive_phase(
         "wall_s": round(wall_s, 3),
         "window_s": round(window_s, 3),
         "roofline": roofline,
+        "disagg": disagg,
         "schedule_digest": schedule_digest(schedule),
     }
 

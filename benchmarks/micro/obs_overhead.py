@@ -20,11 +20,19 @@ Four configurations over the SAME ContinuousBatcher steady state
 - ``engine``  — timeline + ``obs_engine`` per-phase histograms
   (``engine.phase.{admit,prefill,decode,commit,update}_s``).
 - ``trace``   — engine + the span ring (prefill/decode-chunk spans).
+- ``federation`` — trace + the telemetry-federation REPORT PATH
+  (``utils/telemetry``): a ``TelemetryReporter.collect()`` (windowed
+  snapshot delta + reservoir serialization + flight/span drain) folded
+  into a ``FederatedStore`` every ``REPORT_EVERY`` ticks — the
+  worker-side collect and the parent-side ingest of one report, i.e.
+  both halves of the fleet path, timed inside the serving loop.
 
-One JSON line: value = fully-enabled ("trace") overhead vs the floor in
-percent; ``vs_baseline`` = the 5% budget minus the measured overhead
-(positive = within budget). Per-config per-tick means and the
-engine-only overhead ride in extras.
+TWO JSON lines: ``micro_obs_overhead_pct`` (fully-enabled "trace"
+overhead vs the floor, percent; ``vs_baseline`` = the 5% budget minus
+the measured overhead, positive = within budget) and
+``micro_obs_federation_pct`` (federation config vs the same floor,
+same budget — gated via benchmarks/baselines/seed.json). Per-config
+per-tick means and the engine-only overhead ride in extras.
 
 Timing note (benchmarks/common.py): ticks end in a real host fetch of
 the chunk's tokens, so the region is honestly bounded per tick.
@@ -45,6 +53,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
 from benchmarks.common import emit, int_flag  # noqa: E402
 
 BUDGET_PCT = 5.0
+#: Telemetry-report cadence in TICKS for the federation config — far
+#: more aggressive than production (reports go out on a seconds-scale
+#: wall cadence there), so the measured overhead upper-bounds the
+#: real one.
+REPORT_EVERY = 4
 
 
 def main() -> int:
@@ -67,10 +80,10 @@ def main() -> int:
         from adapt_tpu.utils.profiling import global_engine_obs
 
         chunk = 8
-        # Requests must OUTLIVE every measured window (warmup + 4
+        # Requests must OUTLIVE every measured window (warmup + 5
         # configs x trials x n_ticks), or late ticks measure an idle
         # batcher: size max_len from the measurement plan.
-        total_ticks = n_ticks * (4 * trials + 1) + 8
+        total_ticks = n_ticks * (5 * trials + 1) + 8
         steps = total_ticks * chunk
         lm = lm_tiny(vocab=37, max_len=steps + 16)
         variables = lm.graph.init(
@@ -95,11 +108,20 @@ def main() -> int:
         for _ in range(n_ticks):  # warm caches before ANY timed window
             bat.tick()
 
+        from adapt_tpu.utils.telemetry import (
+            FederatedStore,
+            TelemetryReporter,
+        )
+
+        store = FederatedStore()
+        reporter = TelemetryReporter("bench", "obs0")
+
         configs = {  # name -> (obs_timeline, obs_engine, tracer.enabled)
             "off": (False, False, False),
             "timeline": (True, False, False),
             "engine": (True, True, False),
             "trace": (True, True, True),
+            "federation": (True, True, True),
         }
         best = {name: float("inf") for name in configs}
         # Round-robin trials + best-of, ROTATING the config order each
@@ -114,15 +136,28 @@ def main() -> int:
                 bat.obs_timeline = timeline
                 eobs.enabled = engine
                 tracer.enabled = trace
+                federate = name == "federation"
                 t0 = time.perf_counter()
-                for _ in range(n_ticks):
+                for i in range(n_ticks):
                     bat.tick()
+                    if federate and i % REPORT_EVERY == 0:
+                        # Both halves of the fleet report path inside
+                        # the timed region: the worker-side collect
+                        # (windowed delta + reservoir serialization)
+                        # and the parent-side ingest.
+                        store.ingest(reporter.collect())
                 best[name] = min(
                     best[name], (time.perf_counter() - t0) / n_ticks
                 )
+                if federate:
+                    # Close the chained snapshot window OUTSIDE the
+                    # timed region: an open window's reservoir forks
+                    # would tax every OTHER config's observe() calls.
+                    reporter.close()
         t_off, t_timeline, t_engine, t_trace = (
             best["off"], best["timeline"], best["engine"], best["trace"]
         )
+        t_fed = best["federation"]
         tracer.enabled = False
         eobs.enabled = False
         still_active = bat.stats()["active"]
@@ -132,6 +167,7 @@ def main() -> int:
                 f"({still_active}/{slots} slots active)"
             )
         overhead_pct = (t_trace / t_off - 1.0) * 100.0
+        federation_pct = (t_fed / t_off - 1.0) * 100.0
         emit(
             "micro_obs_overhead_pct",
             overhead_pct,
@@ -149,10 +185,28 @@ def main() -> int:
             trials=trials,
             chunk=bat.chunk,
         )
+        emit(
+            "micro_obs_federation_pct",
+            federation_pct,
+            "% tick wall time (trace + telemetry report path vs off)",
+            BUDGET_PCT - federation_pct,
+            budget_pct=BUDGET_PCT,
+            tick_federation_ms=round(t_fed * 1e3, 4),
+            report_every_ticks=REPORT_EVERY,
+            reports_ingested=store.sources()
+            .get("bench:obs0:%d" % os.getpid(), {})
+            .get("reports", 0),
+        )
     except Exception as e:  # noqa: BLE001 — always one JSON line, rc 0
         emit(
             "micro_obs_overhead_pct", 0.0,
             "% tick wall time (trace+engine+timeline vs off)", 0.0,
+            error=str(e)[-300:],
+        )
+        emit(
+            "micro_obs_federation_pct", 0.0,
+            "% tick wall time (trace + telemetry report path vs off)",
+            0.0,
             error=str(e)[-300:],
         )
     return 0
