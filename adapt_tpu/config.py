@@ -137,6 +137,43 @@ class ParallelConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class KernelConfig:
+    """Decode-kernel dispatch knobs for the serving tier
+    (``ops/decode_attention`` + ``ops/paged_attention``;
+    ``docs/SERVING.md`` §3).
+
+    ``attn_impl`` picks the attention implementation the batcher's
+    decode/verify programs lower against: ``None`` = the measured auto
+    rule (``decode_kernel_wins`` / TPU-with-supported-pages), ``"xla"``
+    = the einsum oracle, ``"pallas"`` = the streaming kernel (fused
+    int8/int4 dequant in VMEM). ``decode_split`` is the flash-decoding
+    split along the KV-length axis: each split streams its share of the
+    cache blocks (pages, in the paged layout) with its own
+    online-softmax state and a single-pass rescale combine reduces the
+    partials — long-context slots use the whole VPU/MXU instead of one
+    sequential stream. ``None`` auto-derives from the block count
+    (``ops.decode_attention.default_decode_split``) on real TPUs and
+    stays 1 off-TPU; 1 is the original single-stream kernel, bit-exact.
+    Which path actually serves is observable as the
+    ``engine.kernel_dispatch.<op>`` gauges
+    (``docs/OBSERVABILITY.md``)."""
+
+    attn_impl: str | None = None
+    decode_split: int | None = None
+
+    def __post_init__(self):
+        if self.attn_impl not in (None, "xla", "pallas"):
+            raise ValueError(
+                f"attn_impl={self.attn_impl!r}: expected None, 'xla' "
+                "or 'pallas'"
+            )
+        if self.decode_split is not None and self.decode_split < 1:
+            raise ValueError(
+                f"decode_split must be >= 1, got {self.decode_split}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class SpeculativeConfig:
     """Batched speculative decoding knobs (``runtime/continuous``
     speculative mode; ``docs/SERVING.md`` §5).
@@ -167,6 +204,23 @@ class SpeculativeConfig:
     #: is the target's property), so a slightly-perturbed draft is the
     #: cheapest capacity knob speculation has.
     draft_weight_dtype: str = "native"
+    #: TREE-DRAFT width: 0 = chain speculation (the default). w >= 1
+    #: appends w SIBLING leaf candidates for the position after the
+    #: chain — the draft's top-w next tokens at its final scan step,
+    #: harvested from logits the scan already computed (no extra draft
+    #: forward) — and the verify chunk scores chain + leaves in ONE
+    #: pass via the tree mask (``ops.decode_attention.verify_attention
+    #: tree_tail``). When the whole chain accepts AND the target's
+    #: correction token matches a leaf, that leaf's K/V is already in
+    #: cache and the target's prediction AFTER it commits too: up to
+    #: ``draft_k + 2`` tokens per verify pass instead of
+    #: ``draft_k + 1``, at equal draft FLOPs per committed token. The
+    #: draft scan runs one extra step to keep its own cache covering
+    #: the leaf position (w > 1 leaves beyond the draft's argmax leave
+    #: a draft-side cache entry for the argmax leaf only — an
+    #: acceptance-rate nick on the sibling branches, never a
+    #: correctness issue: losslessness is the target's property).
+    tree_width: int = 0
 
     def __post_init__(self):
         if self.draft_k < 1:
@@ -175,6 +229,10 @@ class SpeculativeConfig:
             raise ValueError(
                 f"draft_weight_dtype={self.draft_weight_dtype!r}: "
                 "expected 'native' or 'int8'"
+            )
+        if self.tree_width < 0:
+            raise ValueError(
+                f"tree_width must be >= 0, got {self.tree_width}"
             )
 
 
@@ -531,6 +589,9 @@ class ServeConfig:
     )
     spec: SpeculativeConfig = dataclasses.field(
         default_factory=SpeculativeConfig
+    )
+    kernel: KernelConfig = dataclasses.field(
+        default_factory=KernelConfig
     )
     parallel: ParallelConfig = dataclasses.field(
         default_factory=ParallelConfig

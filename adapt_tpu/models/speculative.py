@@ -92,8 +92,9 @@ def _prefill(lm: TransformerLM, variables, prompt, *, cache_len: int):
     return jnp.argmax(logits, axis=-1).astype(prompt.dtype), caches
 
 
-@partial(jax.jit, static_argnames=("lm", "n"), donate_argnums=(4,))
-def draft_chunk(lm: TransformerLM, variables, tok, index, caches, *, n):
+@partial(jax.jit, static_argnames=("lm", "n", "tail_w"), donate_argnums=(4,))
+def draft_chunk(lm: TransformerLM, variables, tok, index, caches, *, n,
+                tail_w=0):
     """``n`` greedy decode steps of the draft model: consumes ``tok``
     ((b,)) at ``index``, returns its next-token chain (n, b) and updated
     caches (donated — the round loop owns them).
@@ -103,6 +104,13 @@ def draft_chunk(lm: TransformerLM, variables, tok, index, caches, *, n):
     negative rows are dead slots whose writes clamp into their own
     row's masked space). One compiled program either way; the
     continuous batcher's speculative tick calls this exact jit.
+
+    ``tail_w`` > 0 (tree drafts, ``SpeculativeConfig.tree_width``) also
+    harvests each step's TOP-``tail_w`` token ids — grouped sibling
+    proposals the verify pass scores as tree leaves. The extra ids come
+    from logits the scan already computed (one ``lax.top_k`` per step),
+    so widening the tree costs no extra draft forward passes; the
+    return becomes ``(toks, (n, b, tail_w) top ids, caches)``.
 
     ``variables`` may carry int8-quantized matrix leaves
     (``SpeculativeConfig.draft_weight_dtype="int8"``,
@@ -132,12 +140,18 @@ def draft_chunk(lm: TransformerLM, variables, tok, index, caches, *, n):
             new_caches.append((ck, cv))
         logits = head.apply(variables["head"], x)[:, 0]
         nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+        if tail_w:
+            top = lax.top_k(logits, tail_w)[1].astype(tok.dtype)  # (b, w)
+            return (nxt, index + 1, tuple(new_caches)), (nxt, top)
         return (nxt, index + 1, tuple(new_caches)), nxt
 
-    (_, _, caches), toks = lax.scan(
+    (_, _, caches), ys = lax.scan(
         step, (tok, index, tuple(caches)), None, length=n
     )
-    return toks, list(caches)
+    if tail_w:
+        toks, tops = ys
+        return toks, tops, list(caches)
+    return ys, list(caches)
 
 
 def accept_speculation(props, preds):

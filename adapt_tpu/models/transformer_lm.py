@@ -247,14 +247,24 @@ class CausalSelfAttention(nn.Module):
         """Fan one K/V cache write out over the cache's representation:
         quantized ``(values, scales)`` pairs quantize ``k``/``v`` with
         the shared absmax scheme and apply ``write`` to BOTH members;
-        native caches write directly. ``write(member, new)`` is each
+        native caches write directly. The cache's VALUE width is
+        authoritative for the quantized dtype: a ``head_dim // 2`` lane
+        member is an int4-PACKED pool (two nibbles per int8 lane —
+        ``ops.quantize.quantize_kv_vectors(..., "int4")``), so every
+        write packs to match without any extra plumbing.
+        ``write(member, new)`` is each
         call site's own primitive (page scatter, chunk scatter,
         ``append_kv``) — this is THE one quantize-then-write-both
         definition, so the decode/prefill/verify paths cannot
         diverge."""
         if isinstance(cache_k, tuple):
-            kq, ks = self._quantize_kv(k)
-            vq, vs = self._quantize_kv(v)
+            dt = (
+                "int4"
+                if cache_k[0].shape[-1] * 2 == k.shape[-1]
+                else "int8"
+            )
+            kq, ks = self._quantize_kv(k, dt)
+            vq, vs = self._quantize_kv(v, dt)
             return (
                 (write(cache_k[0], kq), write(cache_k[1], ks)),
                 (write(cache_v[0], vq), write(cache_v[1], vs)),
@@ -273,8 +283,11 @@ class CausalSelfAttention(nn.Module):
         so a ragged long-context prefill streams instead of falling back
         to the O(S^2) oracle.
 
-        ``quantize_cache`` stores the cache int8 (one absmax scale per
-        key/value vector). This is a CONTEXT-CAPACITY feature, not a
+        ``quantize_cache`` stores the cache quantized: ``True`` /
+        ``"int8"`` = int8 (one absmax scale per key/value vector),
+        ``"int4"`` = the 15-level nibble lattice PACKED two per int8
+        lane (values ``head_dim // 2`` wide, same scale plane). This is
+        a CONTEXT-CAPACITY feature, not a
         speed feature: cache bytes drop ~1.9x vs bf16 (measured
         603,979,776 -> 320,864,256 at bs8/2k, so ~1.9x more context per
         chip), but the hardware A/B (r04 `lm_decode_long_{native,int8}`)
@@ -297,8 +310,9 @@ class CausalSelfAttention(nn.Module):
         pad = ((0, 0), (0, 0), (0, max_len - s), (0, 0))
         out = self.out(jnp.swapaxes(o, 1, 2).reshape(b, s, d))
         if quantize_cache:
-            kv_, ks = self._quantize_kv(k)
-            vv_, vs = self._quantize_kv(v)
+            dt = "int4" if quantize_cache == "int4" else "int8"
+            kv_, ks = self._quantize_kv(k, dt)
+            vv_, vs = self._quantize_kv(v, dt)
             return (
                 out,
                 (jnp.pad(kv_, pad), jnp.pad(ks, pad)),
@@ -315,7 +329,7 @@ class CausalSelfAttention(nn.Module):
 
     def decode_step(
         self, x_t, cache_k, cache_v, index, valid_from=None, quantized=False,
-        attn_impl=None,
+        attn_impl=None, split=None,
     ):
         """One token: write its K/V at ``index``, attend its q over the
         cache. ``index`` is traced — the same compiled step serves every
@@ -327,7 +341,8 @@ class CausalSelfAttention(nn.Module):
         :func:`adapt_tpu.ops.decode_attention.decode_attention` —
         ``attn_impl`` (None = measured auto, ``"xla"``, ``"pallas"``)
         picks between the einsum schedule and the streaming Pallas
-        kernel that dequantizes int8 caches in VMEM."""
+        kernel that dequantizes int8 caches in VMEM; ``split`` is the
+        kernel's flash-decoding KV split (``config.KernelConfig``)."""
         b = x_t.shape[0]
         q, k, v = self._project(x_t)  # q (b, h, 1, hd); k/v (b, kv_h, 1, hd)
         if self.rope:
@@ -351,6 +366,7 @@ class CausalSelfAttention(nn.Module):
         o = decode_attention(
             q, cache_k, cache_v, index,
             self._window_from(index, b, valid_from), prefer=attn_impl,
+            split=split,
         ).astype(x_t.dtype)
         o = self._ungroup_o(o, 1)  # (b, h, 1, hd)
         o = jnp.swapaxes(o, 1, 2).reshape(b, 1, self.dim)
@@ -359,7 +375,7 @@ class CausalSelfAttention(nn.Module):
 
     def decode_step_paged(
         self, x_t, k_pool, v_pool, page_table, index, valid_from=None,
-        attn_impl=None,
+        attn_impl=None, split=None,
     ):
         """One token against a PAGED cache (``ops/paged_attention``):
         write this step's K/V into the slot's physical page at
@@ -405,6 +421,7 @@ class CausalSelfAttention(nn.Module):
         o = paged_attention(
             q, k_pool, v_pool, page_table, index,
             self._window_from(index, b, valid_from), prefer=attn_impl,
+            split=split,
         ).astype(x_t.dtype)
         o = self._ungroup_o(o, 1)
         o = jnp.swapaxes(o, 1, 2).reshape(b, 1, self.dim)
@@ -455,7 +472,7 @@ class CausalSelfAttention(nn.Module):
         o = jnp.swapaxes(o, 1, 2).reshape(b, c, self.dim)
         return self.out(o), k_pool, v_pool
 
-    def verify_chunk(self, x, cache_k, cache_v, index):
+    def verify_chunk(self, x, cache_k, cache_v, index, tree_tail=0):
         """Append a CHUNK of ``K`` tokens at positions
         ``index..index+K-1`` in ONE cached pass — the speculative-decode
         verify primitive: each chunk row's query attends the cache up to
@@ -473,20 +490,34 @@ class CausalSelfAttention(nn.Module):
         pairs quantize the chunk's K/V with the shared absmax scheme
         before the append — the same values K sequential quantized
         ``decode_step`` calls would write, so quantized verify logits
-        equal the sequential quantized decode's."""
+        equal the sequential quantized decode's.
+
+        ``tree_tail`` = w > 0 marks the chunk's last w rows as TREE
+        LEAVES — grouped draft candidates for ONE logical position,
+        ``index + chain + 1`` (chain = K - 1 - w): they embed/rotate at
+        that shared logical position, write at their own DISTINCT
+        physical cache slots (``index + row``, inside the speculative
+        slack), and attend the chain plus only themselves
+        (``ops.decode_attention.verify_attention``'s tree mask) — one
+        verify pass scores every leaf of a draft token tree."""
         b, kc, d = x.shape
         q, k, v = self._project(x)  # q (b, h, K, hd); k/v (b, kv_h, K, hd)
+        offs = jnp.arange(kc)
+        if tree_tail:
+            # Leaves share the logical position after the chain.
+            offs = jnp.minimum(offs, kc - tree_tail)
         if jnp.ndim(index):
-            pos = index[:, None] + jnp.arange(kc)[None, :]  # (b, K)
+            pos = index[:, None] + offs[None, :]  # (b, K)
         else:
-            pos = index + jnp.arange(kc)
+            pos = index + offs
         q, k = self._rope_qk(q, k, pos)
         q = self._group_q(q)  # (b, kv_h, g*K, hd), row = member*K + pos
         cache_k, cache_v = self._write_kv_pair(
             cache_k, cache_v, k, v, lambda c, t: append_kv(c, t, index)
         )
         o = verify_attention(
-            q, cache_k, cache_v, index, kc, window=self.window
+            q, cache_k, cache_v, index, kc, window=self.window,
+            tree_tail=tree_tail,
         ).astype(x.dtype)
         o = self._ungroup_o(o, kc)  # (b, h, K, hd)
         o = jnp.swapaxes(o, 1, 2).reshape(b, kc, self.dim)
@@ -494,6 +525,7 @@ class CausalSelfAttention(nn.Module):
 
     def verify_chunk_paged(
         self, x, k_pool, v_pool, page_table, index, attn_impl=None,
+        tree_tail=0, split=None,
     ):
         """Batched verify over a PAGED cache: scatter each slot's K
         chunk tokens into its own pages at ``index[b]..index[b]+K-1``
@@ -505,17 +537,19 @@ class CausalSelfAttention(nn.Module):
         writes route to the trash page and its positions all mask.
         Quantized ``(values, scales)`` pool pairs scatter the chunk's
         quantized K/V into both members (the scale plane rides the
-        same page table)."""
+        same page table). ``tree_tail``/``split`` as in
+        ``verify_chunk`` / ``decode_step_paged``."""
         b, kc, _ = x.shape
         page = pool_values(k_pool).shape[2]
         q, k, v = self._project(x)  # q (b, h, K, hd); k/v (b, kv_h, K, hd)
         idx = jnp.broadcast_to(
             jnp.asarray(index, jnp.int32).reshape(-1), (b,)
         )
+        offs = jnp.arange(kc)
+        if tree_tail:
+            offs = jnp.minimum(offs, kc - tree_tail)
         if self.rope:
-            q, k = self._rope_qk(
-                q, k, idx[:, None] + jnp.arange(kc)[None, :]
-            )
+            q, k = self._rope_qk(q, k, idx[:, None] + offs[None, :])
         q = self._group_q(q)  # (b, kv_h, g*K, hd)
         live_row = idx >= 0
         pos = jnp.maximum(idx, 0)[:, None] + jnp.arange(kc)[None, :]
@@ -533,7 +567,7 @@ class CausalSelfAttention(nn.Module):
         k_pool, v_pool = self._write_kv_pair(k_pool, v_pool, k, v, write)
         o = paged_verify_attention(
             q, k_pool, v_pool, page_table, idx, kc, prefer=attn_impl,
-            window=self.window,
+            window=self.window, tree_tail=tree_tail, split=split,
         ).astype(x.dtype)
         o = self._ungroup_o(o, kc)
         o = jnp.swapaxes(o, 1, 2).reshape(b, kc, self.dim)
@@ -609,22 +643,22 @@ class DecoderBlock(nn.Module):
 
     def decode_step(
         self, x_t, cache_k, cache_v, index, valid_from=None, quantized=False,
-        attn_impl=None,
+        attn_impl=None, split=None,
     ):
         a, ck, cv = self.attn.decode_step(
             self.ln1(x_t), cache_k, cache_v, index, valid_from, quantized,
-            attn_impl,
+            attn_impl, split,
         )
         x_t = x_t + a
         return x_t + self._mlp(self.ln2(x_t)), ck, cv
 
     def decode_step_paged(
         self, x_t, k_pool, v_pool, page_table, index, valid_from=None,
-        attn_impl=None,
+        attn_impl=None, split=None,
     ):
         a, kp, vp = self.attn.decode_step_paged(
             self.ln1(x_t), k_pool, v_pool, page_table, index, valid_from,
-            attn_impl,
+            attn_impl, split,
         )
         x_t = x_t + a
         return x_t + self._mlp(self.ln2(x_t)), kp, vp
@@ -638,18 +672,20 @@ class DecoderBlock(nn.Module):
         x = x + a
         return x + self._mlp(self.ln2(x)), kp, vp
 
-    def verify_chunk(self, x, cache_k, cache_v, index):
+    def verify_chunk(self, x, cache_k, cache_v, index, tree_tail=0):
         a, ck, cv = self.attn.verify_chunk(
-            self.ln1(x), cache_k, cache_v, index
+            self.ln1(x), cache_k, cache_v, index, tree_tail
         )
         x = x + a
         return x + self._mlp(self.ln2(x)), ck, cv
 
     def verify_chunk_paged(
         self, x, k_pool, v_pool, page_table, index, attn_impl=None,
+        tree_tail=0, split=None,
     ):
         a, kp, vp = self.attn.verify_chunk_paged(
-            self.ln1(x), k_pool, v_pool, page_table, index, attn_impl
+            self.ln1(x), k_pool, v_pool, page_table, index, attn_impl,
+            tree_tail, split,
         )
         x = x + a
         return x + self._mlp(self.ln2(x)), kp, vp
@@ -943,9 +979,10 @@ def validate_generate_args(
         raise ValueError(f"top_k {top_k} exceeds vocab size {lm.vocab}")
     if top_p is not None and not (0.0 < top_p <= 1.0):
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    if kv_cache_dtype not in ("native", "int8"):
+    if kv_cache_dtype not in ("native", "int8", "int4"):
         raise ValueError(
-            f"kv_cache_dtype={kv_cache_dtype!r}: expected 'native' or 'int8'"
+            f"kv_cache_dtype={kv_cache_dtype!r}: expected 'native', "
+            "'int8' or 'int4'"
         )
     if rng is None:
         rng = jax.random.PRNGKey(0)  # unused by the greedy path
@@ -1008,6 +1045,10 @@ def generate(
     speed — the hardware A/B measured decode ~12% slower than the
     native cache at 2k context (see ``prefill``'s docstring and
     ``benchmarks/results/r04/lm_decode_long_*.json``).
+    ``"int4"`` halves the value bytes again (two nibbles packed per
+    int8 lane, same per-vector f32 scale plane) at a larger
+    perturbation — the serving tier gates its top-1 agreement against
+    int8 rather than claiming losslessness.
 
     Sampling: ``temperature=0`` (default) is greedy argmax and needs no
     ``rng``; ``temperature > 0`` samples from ``softmax(logits / T)``,
@@ -1058,7 +1099,12 @@ def generate(
         use_top_p=top_p is not None,
         use_eos=eos_id is not None,
         ragged=prompt_lengths is not None,
-        kv_quant=kv_cache_dtype == "int8",
+        # Static: False, "int8" or "int4" — prefill's quantize_cache
+        # builds the matching (values, scales) representation and the
+        # decode path follows the cache's own width from there.
+        kv_quant=(
+            kv_cache_dtype if kv_cache_dtype != "native" else False
+        ),
         decode_attn=decode_attn,
         return_logprobs=return_logprobs,
     )

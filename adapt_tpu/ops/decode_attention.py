@@ -62,7 +62,68 @@ except Exception:  # pragma: no cover — jax builds without pallas-tpu
     pltpu = None
     _VMEM = None
 
+from adapt_tpu.ops.quantize import unpack_int4
+
 _NEG_INF = -1e30
+
+# -- kernel-vs-oracle dispatch accounting ------------------------------------
+
+#: Last-resolved path + lifetime counts per decode/verify/prefill op —
+#: the ``_kernel_supported`` fallback used to degrade to the XLA oracle
+#: SILENTLY (a perf cliff invisible in metrics). Every dispatcher
+#: records its decision here at trace time; ``utils.profiling``'s
+#: engine collector exports them as ``engine.kernel_dispatch.<op>``
+#: gauges (1.0 = the Pallas kernel, 0.0 = the XLA oracle) plus
+#: per-path totals. Counts move at TRACE time (dispatch is resolved
+#: when the surrounding program lowers, not per executed tick), so the
+#: gauge answers "which path is this serving program actually built
+#: on", which is the question the fallback cliff poses.
+_KERNEL_DISPATCHES: dict[str, dict[str, float]] = {}
+
+
+def record_kernel_dispatch(op: str, path: str) -> None:
+    """Record one dispatch resolution for ``op`` (``"pallas"`` or
+    ``"xla"``)."""
+    d = _KERNEL_DISPATCHES.setdefault(
+        op, {"pallas": 0.0, "xla": 0.0, "last": 0.0}
+    )
+    d[path] += 1.0
+    d["last"] = 1.0 if path == "pallas" else 0.0
+
+
+def kernel_dispatch_stats() -> dict[str, dict[str, float]]:
+    """Snapshot of the per-op dispatch books (copies — safe to mutate)."""
+    return {op: dict(d) for op, d in _KERNEL_DISPATCHES.items()}
+
+
+def default_decode_split(num_blocks: int) -> int:
+    """Auto-derived flash-decoding split factor for a cache of
+    ``num_blocks`` position blocks (pages, for the paged layout): the
+    largest power of two <= 8 that still leaves every split at least
+    two blocks of work. Short caches stay unsplit (the combine pass
+    would cost more than the parallelism buys); long-context slots fan
+    their KV stream across splits so the whole VPU/MXU participates
+    instead of one sequential stream. ``config.KernelConfig.
+    decode_split`` overrides it."""
+    s = 1
+    while s < 8 and num_blocks >= 4 * s:
+        s *= 2
+    return s
+
+
+def resolve_decode_split(num_blocks: int, split: int | None) -> int:
+    """THE split-resolution rule every kernel dispatcher shares (decode
+    / paged decode / paged verify — one definition, so the auto rule
+    cannot fork across them): an explicit ``split`` wins; None
+    auto-derives on real TPUs and stays 1 off-TPU, where the
+    interpreter gains nothing from fan-out."""
+    if split is not None:
+        return split
+    return (
+        default_decode_split(num_blocks)
+        if jax.default_backend() == "tpu"
+        else 1
+    )
 
 #: Cache-position block per grid step for QUANTIZED caches. 1024 = 8
 #: sublanes x 128 lanes of the chunked scale view, the smallest block
@@ -126,6 +187,56 @@ def _supported(cache_len: int, block_k: int, quantized: bool) -> bool:
     return not quantized or block_k % DECODE_BLOCK_K == 0
 
 
+def _attend_tile(q, k, v, ksc, vsc, live, m_scr, l_scr, acc_scr,
+                 sm_scale, packed):
+    """One cache tile's online-softmax update — THE shared step body of
+    every decode/verify/chunk kernel (split or not), so the int8 fused
+    dequant, the int4 nibble unpack and the masking discipline cannot
+    fork across grid layouts. ``q`` (gq, hd); ``k``/``v`` (block_k, hd)
+    native/int8, or (block_k, hd // 2) packed int4 (``packed``);
+    ``ksc``/``vsc`` (1, block_k) f32 column scales or None; ``live``
+    (gq, block_k) bool mask. Mutates the (gq, 1)/(gq, 1)/(gq, hd)
+    scratch refs in place."""
+    if packed:
+        # Unpack two nibbles per streamed int8 lane in VMEM — the HBM
+        # stream stays 4-bit; only the registers see head_dim lanes.
+        k = unpack_int4(k)
+        v = unpack_int4(v)
+    q = q.astype(jnp.float32)
+    k = k.astype(jnp.float32)
+    v = v.astype(jnp.float32)
+    s = (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * sm_scale
+    )  # (gq, block_k)
+    if ksc is not None:
+        # One f32 scale per column of this block: the per-vector scale
+        # factors exactly OUT of the dot, applied to the small score
+        # row instead of the big cache operand.
+        s = s * ksc
+    s = jnp.where(live, s, _NEG_INF)
+    m = m_scr[...]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    m_scr[...] = m_new
+    l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = p * vsc if vsc is not None else p
+    acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+        pv, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _init_softmax_scratch(m_scr, l_scr, acc_scr):
+    m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+    l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+    acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+
 def _decode_kernel(
     q_ref,
     k_ref,
@@ -137,10 +248,12 @@ def _decode_kernel(
     sm_scale,
     quantized,
     has_vf,
+    packed=False,
 ):
     """One (batch, kv_head) row: stream cache blocks innermost, online
     softmax in scratch. ``q_ref`` (1, gq, hd) — gq = GQA group rows,
-    sublane-padded; ``k_ref``/``v_ref`` (1, block_k, hd) int8 or native;
+    sublane-padded; ``k_ref``/``v_ref`` (1, block_k, hd) int8 or native
+    (``packed``: (1, block_k, hd // 2) int4 nibbles, unpacked in VMEM);
     scale tiles (1, 8, 128) f32 chunked views covering this block's
     positions row-major; ``idx_ref``/``vf_ref`` (1,) SMEM scalars."""
     refs = list(refs)
@@ -153,46 +266,20 @@ def _decode_kernel(
 
     @pl.when(j == 0)
     def _init():
-        m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
-        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
-        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+        _init_softmax_scratch(m_scr, l_scr, acc_scr)
 
     def _step():
-        q = q_ref[0].astype(jnp.float32)  # (gq, hd)
-        k = k_ref[0].astype(jnp.float32)  # (block_k, hd)
-        v = v_ref[0].astype(jnp.float32)
-        s = (
-            jax.lax.dot_general(
-                q,
-                k,
-                (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * sm_scale
-        )  # (gq, block_k)
-        if quantized:
-            # (8, 128) chunk -> one scale per column of this block; the
-            # per-vector scale factors exactly OUT of the dot, applied
-            # to the small score row instead of the big cache operand.
-            ksc = ksc_ref[0].reshape(1, block_k)
-            s = s * ksc
         cols = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (gq, block_k), 1
         )
         live = cols <= idx_ref[0]
         if has_vf:
             live = jnp.logical_and(live, cols >= vf_ref[0])
-        s = jnp.where(live, s, _NEG_INF)
-        m = m_scr[...]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        m_scr[...] = m_new
-        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = p * vsc_ref[0].reshape(1, block_k) if quantized else p
-        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            pv, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        _attend_tile(
+            q_ref[0], k_ref[0], v_ref[0],
+            ksc_ref[0].reshape(1, block_k) if quantized else None,
+            vsc_ref[0].reshape(1, block_k) if quantized else None,
+            live, m_scr, l_scr, acc_scr, sm_scale, packed,
         )
 
     # Blocks entirely past the write index (the still-dead cache tail)
@@ -211,13 +298,105 @@ def _decode_kernel(
         ).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_k",))
+def _decode_split_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    idx_ref,
+    *refs,
+    block_k,
+    num_kv,
+    bps,
+    sm_scale,
+    quantized,
+    has_vf,
+    packed=False,
+):
+    """Flash-decoding split variant of :func:`_decode_kernel`: grid
+    (b * kv_h, split, bps) — each (row, split) streams ITS ``bps``
+    cache blocks with its own online-softmax scratch and emits
+    UNNORMALIZED partials (f32 accumulator + running max + denominator)
+    instead of a normalized output; the caller's single-pass rescale
+    combine (:func:`_combine_splits`) reduces them. Splits are
+    independent, so the grid's split axis is ``parallel`` — a
+    long-context row's KV stream fans across compute units instead of
+    one sequential walk. The last split may be RAGGED (``split * bps >
+    num_kv``): its out-of-range blocks clamp in the index maps and mask
+    here, contributing nothing."""
+    refs = list(refs)
+    ksc_ref = refs.pop(0) if quantized else None
+    vsc_ref = refs.pop(0) if quantized else None
+    vf_ref = refs.pop(0) if has_vf else None
+    o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = refs
+    s_id = pl.program_id(1)
+    j = pl.program_id(2)
+    jg = s_id * bps + j  # global block index
+    gq = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        _init_softmax_scratch(m_scr, l_scr, acc_scr)
+
+    def _step():
+        cols = jg * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (gq, block_k), 1
+        )
+        live = cols <= idx_ref[0]
+        if has_vf:
+            live = jnp.logical_and(live, cols >= vf_ref[0])
+        _attend_tile(
+            q_ref[0], k_ref[0], v_ref[0],
+            ksc_ref[0].reshape(1, block_k) if quantized else None,
+            vsc_ref[0].reshape(1, block_k) if quantized else None,
+            live, m_scr, l_scr, acc_scr, sm_scale, packed,
+        )
+
+    live_block = jnp.logical_and(
+        jg < num_kv, jg * block_k <= idx_ref[0]
+    )
+    if has_vf:
+        live_block = jnp.logical_and(
+            live_block, (jg + 1) * block_k > vf_ref[0]
+        )
+    pl.when(live_block)(_step)
+
+    @pl.when(j == bps - 1)
+    def _emit():
+        hd = o_ref.shape[-1]
+        o_ref[0, 0] = acc_scr[...]
+        # m/l broadcast across the lane axis so the partial outputs
+        # share the accumulator's (gq, hd) tiling; the combine reads
+        # lane 0.
+        m_ref[0, 0] = jnp.broadcast_to(m_scr[...], (gq, hd))
+        l_ref[0, 0] = jnp.broadcast_to(l_scr[...], (gq, hd))
+
+
+def _combine_splits(o_parts, m_parts, l_parts, out_dtype):
+    """Single-pass rescale combine of flash-decoding split partials:
+    ``o`` (rows, split, gq, hd) unnormalized f32 accumulators, ``m``/
+    ``l`` running max / denominator broadcast over the lane axis (lane
+    0 read). A split whose every block was dead carries (m = -inf,
+    l = 0) and contributes nothing; an all-dead row emits finite
+    garbage (0) exactly like the unsplit kernel's ``acc / max(l,
+    eps)``."""
+    m = m_parts[..., :1]  # (rows, split, gq, 1)
+    l = l_parts[..., :1]
+    m_star = jnp.max(m, axis=1, keepdims=True)
+    alpha = jnp.exp(m - m_star)
+    denom = jnp.sum(l * alpha, axis=1)  # (rows, gq, 1)
+    out = jnp.sum(o_parts * alpha, axis=1)
+    return (out / jnp.maximum(denom, 1e-30)).astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "split"))
 def _decode_impl(q, k_vals, v_vals, k_scales, v_scales, index, valid_from,
-                 block_k):
+                 block_k, split=1):
     b, kvh, g, hd = q.shape
     cache_len = k_vals.shape[2]
+    hdk = k_vals.shape[3]  # head_dim // 2 for packed int4 pools
     num_kv = cache_len // block_k
     quantized = k_scales is not None
+    packed = quantized and hdk * 2 == hd
     has_vf = valid_from is not None
     pad_g = (-g) % 8  # sublane-pad the query rows
     if pad_g:
@@ -225,25 +404,40 @@ def _decode_impl(q, k_vals, v_vals, k_scales, v_scales, index, valid_from,
     gq = g + pad_g
 
     qf = q.reshape(b * kvh, gq, hd)
-    kf = k_vals.reshape(b * kvh, cache_len, hd)
-    vf = v_vals.reshape(b * kvh, cache_len, hd)
+    kf = k_vals.reshape(b * kvh, cache_len, hdk)
+    vf = v_vals.reshape(b * kvh, cache_len, hdk)
     idx = jnp.repeat(
         jnp.broadcast_to(jnp.asarray(index, jnp.int32).reshape(-1), (b,)),
         kvh,
     )
     sm_scale = 1.0 / (hd ** 0.5)
+    bps = -(-num_kv // split)  # blocks per split; last split may be ragged
+
+    def blk(bh, *js):
+        # Global block index from the (possibly split) grid point,
+        # clamped for the ragged tail (masked in-kernel).
+        if split == 1:
+            (j,) = js
+            return j
+        s_id, j = js
+        return jnp.minimum(s_id * bps + j, num_kv - 1)
+
+    def row_map(bh, *js):
+        del js
+        return (bh, 0, 0)
+
+    def kv_map(bh, *js):
+        return (bh, blk(bh, *js), 0)
+
+    def smem_map(bh, *js):
+        del js
+        return (bh,)
 
     in_specs = [
-        pl.BlockSpec(
-            (1, gq, hd), lambda bh, j: (bh, 0, 0), memory_space=_VMEM
-        ),
-        pl.BlockSpec(
-            (1, block_k, hd), lambda bh, j: (bh, j, 0), memory_space=_VMEM
-        ),
-        pl.BlockSpec(
-            (1, block_k, hd), lambda bh, j: (bh, j, 0), memory_space=_VMEM
-        ),
-        pl.BlockSpec((1,), lambda bh, j: (bh,), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, gq, hd), row_map, memory_space=_VMEM),
+        pl.BlockSpec((1, block_k, hdk), kv_map, memory_space=_VMEM),
+        pl.BlockSpec((1, block_k, hdk), kv_map, memory_space=_VMEM),
+        pl.BlockSpec((1,), smem_map, memory_space=pltpu.SMEM),
     ]
     operands = [qf, kf, vf, idx]
     if quantized:
@@ -256,47 +450,89 @@ def _decode_impl(q, k_vals, v_vals, k_scales, v_scales, index, valid_from,
             operands.append(chunk(s.astype(jnp.float32)))
             in_specs.append(
                 pl.BlockSpec(
-                    (1, rows_per_block, 128),
-                    lambda bh, j: (bh, j, 0),
-                    memory_space=_VMEM,
+                    (1, rows_per_block, 128), kv_map, memory_space=_VMEM
                 )
             )
     if has_vf:
         operands.append(jnp.repeat(jnp.asarray(valid_from, jnp.int32), kvh))
         in_specs.append(
-            pl.BlockSpec((1,), lambda bh, j: (bh,), memory_space=pltpu.SMEM)
+            pl.BlockSpec((1,), smem_map, memory_space=pltpu.SMEM)
         )
 
     on_tpu = jax.default_backend() == "tpu"
-    out = pl.pallas_call(
+    scratch = [
+        pltpu.VMEM((gq, 1), jnp.float32),
+        pltpu.VMEM((gq, 1), jnp.float32),
+        pltpu.VMEM((gq, hd), jnp.float32),
+    ]
+    if split == 1:
+        out = pl.pallas_call(
+            functools.partial(
+                _decode_kernel,
+                block_k=block_k,
+                num_kv=num_kv,
+                sm_scale=sm_scale,
+                quantized=quantized,
+                has_vf=has_vf,
+                packed=packed,
+            ),
+            grid=(b * kvh, num_kv),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, gq, hd), row_map, memory_space=_VMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct((b * kvh, gq, hd), q.dtype),
+            scratch_shapes=scratch,
+            compiler_params=(
+                pltpu.CompilerParams(
+                    dimension_semantics=("parallel", "arbitrary")
+                )
+                if on_tpu
+                else None
+            ),
+            interpret=not on_tpu,
+        )(*operands)
+        return out.reshape(b, kvh, gq, hd)[:, :, :g, :]
+
+    # Flash-decoding split: (row, split) partials + single-pass rescale.
+    def part_map(bh, s_id, j):
+        del j
+        return (bh, s_id, 0, 0)
+
+    o_p, m_p, l_p = pl.pallas_call(
         functools.partial(
-            _decode_kernel,
+            _decode_split_kernel,
             block_k=block_k,
             num_kv=num_kv,
+            bps=bps,
             sm_scale=sm_scale,
             quantized=quantized,
             has_vf=has_vf,
+            packed=packed,
         ),
-        grid=(b * kvh, num_kv),
+        grid=(b * kvh, split, bps),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec(
-            (1, gq, hd), lambda bh, j: (bh, 0, 0), memory_space=_VMEM
+        out_specs=(
+            pl.BlockSpec((1, 1, gq, hd), part_map, memory_space=_VMEM),
+            pl.BlockSpec((1, 1, gq, hd), part_map, memory_space=_VMEM),
+            pl.BlockSpec((1, 1, gq, hd), part_map, memory_space=_VMEM),
         ),
-        out_shape=jax.ShapeDtypeStruct((b * kvh, gq, hd), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((gq, 1), jnp.float32),
-            pltpu.VMEM((gq, 1), jnp.float32),
-            pltpu.VMEM((gq, hd), jnp.float32),
-        ],
+        out_shape=(
+            jax.ShapeDtypeStruct((b * kvh, split, gq, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b * kvh, split, gq, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b * kvh, split, gq, hd), jnp.float32),
+        ),
+        scratch_shapes=scratch,
         compiler_params=(
             pltpu.CompilerParams(
-                dimension_semantics=("parallel", "arbitrary")
+                dimension_semantics=("parallel", "parallel", "arbitrary")
             )
             if on_tpu
             else None
         ),
         interpret=not on_tpu,
     )(*operands)
+    out = _combine_splits(o_p, m_p, l_p, q.dtype)
     return out.reshape(b, kvh, gq, hd)[:, :, :g, :]
 
 
@@ -322,7 +558,8 @@ def append_kv(cache, new, index):
     return lax.dynamic_update_slice(cache, new, (0, 0, index, 0))
 
 
-def verify_attention(q, cache_k, cache_v, index, chunk: int, window=None):
+def verify_attention(q, cache_k, cache_v, index, chunk: int, window=None,
+                     tree_tail: int = 0):
     """Multi-token VERIFY attention: K chunk rows per slot, each
     attending the cache up to its OWN position — the speculative-decode
     primitive (K causal logits for one weight stream).
@@ -344,6 +581,15 @@ def verify_attention(q, cache_k, cache_v, index, chunk: int, window=None):
     logits equal what K sequential quantized ``decode_step`` calls
     produce: the speculative-verify path over an int8 cache.
 
+    ``tree_tail`` = w > 0 marks the chunk's LAST w rows as TREE LEAVES
+    (grouped draft proposals sharing the chain prefix — speculative
+    tree drafts): leaf row r attends the whole chain (cols <= index +
+    chain, chain = chunk - 1 - w) PLUS its own physical slot (col ==
+    index + r) and nothing of its siblings, so one verify pass scores
+    every leaf candidate for logical position chain + 1 at once. Chain
+    rows keep the ordinary per-row diagonal (their own slot is inside
+    it).
+
     The einsum schedule is ``decode_attention_reference``'s with a
     per-row diagonal instead of a shared newest position; XLA-only for
     now (``decode_kernel_wins`` rules the streaming kernel out
@@ -354,6 +600,8 @@ def verify_attention(q, cache_k, cache_v, index, chunk: int, window=None):
     if quantized:
         (kvl, ksc), (vvl, vsc) = cache_k, cache_v
         check_head_parity(q.shape[1], kvl.shape[1])
+        if kvl.shape[-1] * 2 == q.shape[-1]:  # packed int4 nibbles
+            kvl, vvl = unpack_int4(kvl), unpack_int4(vvl)
         # Scales factor OUT of the per-vector dot: apply them to the
         # score columns in decode_attention_reference's exact op order,
         # so per-row values match the sequential quantized decode.
@@ -376,17 +624,29 @@ def verify_attention(q, cache_k, cache_v, index, chunk: int, window=None):
         n_pos = cache_k.shape[2]
     cols = jnp.arange(n_pos)
     rows = jnp.arange(q.shape[2]) % chunk  # row -> chunk position t
+    # Tree leaves attend up to the CHAIN edge (depth), chain rows up to
+    # their own diagonal; every row's own physical slot is always live
+    # (for chain rows it already is — own <= edge).
+    depth = (
+        jnp.minimum(rows, chunk - 1 - tree_tail) if tree_tail else rows
+    )
     if jnp.ndim(index):
-        edge = index[:, None, None] + rows[None, :, None]  # (b, g*K, 1)
+        edge = index[:, None, None] + depth[None, :, None]  # (b, g*K, 1)
         live = cols[None, None, :] <= edge
         if window is not None:
             live = live & (cols[None, None, :] > edge - window)
+        if tree_tail:
+            own = index[:, None, None] + rows[None, :, None]
+            live = live | (cols[None, None, :] == own)
         s = jnp.where(live[:, None], s, _NEG_INF)
     else:
-        edge = index + rows[:, None]  # (g*K, 1)
+        edge = index + depth[:, None]  # (g*K, 1)
         live = cols[None, :] <= edge
         if window is not None:
             live = live & (cols[None, :] > edge - window)
+        if tree_tail:
+            own = index + rows[:, None]
+            live = live | (cols[None, :] == own)
         s = jnp.where(live[None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     if quantized:
@@ -415,6 +675,8 @@ def decode_attention_reference(q, cache_k, cache_v, index, valid_from=None):
     sm = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
     if quantized:
         (kvl, ksc), (vvl, vsc) = cache_k, cache_v
+        if kvl.shape[-1] * 2 == q.shape[-1]:  # packed int4 nibbles
+            kvl, vvl = unpack_int4(kvl), unpack_int4(vvl)
         s = jnp.einsum(
             "bhqd,bhkd->bhqk",
             q.astype(jnp.float32),
@@ -460,6 +722,7 @@ def decode_attention(
     valid_from=None,
     prefer: str | None = None,
     block_k: int | None = None,
+    split: int | None = None,
 ) -> jax.Array:
     """Cached decode attention over the live window ``[valid_from,
     index]`` of a KV cache.
@@ -476,7 +739,16 @@ def decode_attention(
     (falls back to the oracle off-pallas or when L doesn't divide into
     supported blocks: native caches need L % 256 == 0, int8 caches
     L % 1024 == 0 — the scale-tile layout). ``block_k`` None picks the
-    largest supported block (``default_block_k``). Every grid/fold/block
+    largest supported block (``default_block_k``). ``split`` is the
+    flash-decoding KV-length split factor: None auto-derives
+    (``default_decode_split`` of the block count on real TPUs; 1
+    off-TPU, where the interpreter gains nothing from fan-out), 1 runs
+    the original single-stream kernel bit-exactly, > 1 fans the cache
+    stream across independent grid splits with a single-pass rescale
+    combine. Caches may also be PACKED int4 pairs (values
+    ``head_dim // 2`` wide — ``ops.quantize.quantize_kv_vectors(...,
+    "int4")``); the kernels unpack nibbles in VMEM so the HBM stream
+    stays 4-bit. Every grid/fold/block
     derives from the shapes GIVEN — the per-shard head count under
     tensor parallelism — so a q/cache head mismatch fails loud
     (``check_head_parity``)."""
@@ -496,14 +768,18 @@ def decode_attention(
             f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
         )
     if prefer == "pallas" and _supported(cache_len, block_k, quantized):
+        split = resolve_decode_split(cache_len // block_k, split)
+        record_kernel_dispatch("decode", "pallas")
         if quantized:
             (kvl, ksc), (vvl, vsc) = cache_k, cache_v
             return _decode_impl(
-                q, kvl, vvl, ksc, vsc, index, valid_from, block_k
+                q, kvl, vvl, ksc, vsc, index, valid_from, block_k, split
             )
         return _decode_impl(
-            q, cache_k, cache_v, None, None, index, valid_from, block_k
+            q, cache_k, cache_v, None, None, index, valid_from, block_k,
+            split,
         )
+    record_kernel_dispatch("decode", "xla")
     return decode_attention_reference(
         q, cache_k, cache_v, index, valid_from
     )

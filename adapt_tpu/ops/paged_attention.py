@@ -21,13 +21,27 @@ state carries across them. The kernel body is ``ops/decode_attention``'s
 FETCH differs, which is the whole point: one attention discipline, two
 memory layouts.
 
+The grid's page axis can additionally FLASH-SPLIT (``split`` on every
+dispatcher, ``config.KernelConfig.decode_split``): each (row, split)
+grid point streams its own run of the slot's pages with independent
+online-softmax scratch and emits unnormalized partials (accumulator +
+running max + denominator); a single-pass rescale combine reduces them
+— so a long-context slot's KV stream fans across compute units instead
+of one sequential page walk. ``split=1`` is the original kernel
+bit-exactly; the last split may be ragged (clamped in the index maps,
+masked in the kernel).
+
 Layouts:
 - pool: (num_pages, kv_heads, page_size, head_dim) in the native dtype
   (bf16/f32), OR an ``(int8 values, f32 scales)`` PAIR of pools —
   values (num_pages, kv_heads, page_size, head_dim) int8, scales
   (num_pages, kv_heads, page_size, 1) f32, one absmax scale per cached
   K/V vector (``ops/quantize.quantize_kv_vectors``, the same scheme as
-  the dense int8 strips). Quantized pools compose paging's
+  the dense int8 strips). int4 pools keep the pair shape with the
+  VALUE plane packed two nibbles per int8 lane (width head_dim // 2,
+  ``quantize_kv_vectors(..., "int4")``); the kernels detect the packed
+  width against q's head_dim and unpack in VMEM, so the HBM stream is
+  4-bit. Quantized pools compose paging's
   resident-token capacity with int8's ~2-4x byte shrink: the scale
   plane rides the SAME page table (page id addresses both pools), and
   the kernels stream it as one chunked (page/128, 128) f32 tile per
@@ -65,9 +79,16 @@ from jax.experimental import pallas as pl
 
 from adapt_tpu.ops.decode_attention import (
     DECODE_BLOCK_K,
+    _attend_tile,
+    _combine_splits,
     _decode_kernel,
+    _decode_split_kernel,
+    _init_softmax_scratch,
     check_head_parity,
+    record_kernel_dispatch,
+    resolve_decode_split,
 )
+from adapt_tpu.ops.quantize import unpack_int4
 
 try:
     from jax.experimental.pallas import tpu as pltpu
@@ -148,13 +169,15 @@ def paged_attention_reference(q, k_pool, v_pool, page_table, index,
     )
 
 
-@functools.partial(jax.jit, static_argnames=())
+@functools.partial(jax.jit, static_argnames=("split",))
 def _paged_impl(q, k_pool, v_pool, k_scales, v_scales, page_table, index,
-                valid_from):
+                valid_from, split=1):
     b, kvh, g, hd = q.shape
     page = k_pool.shape[2]
-    pages_per_slot = page_table.shape[1]
+    hdk = k_pool.shape[3]  # head_dim // 2 for packed int4 pools
     quantized = k_scales is not None
+    packed = quantized and hdk * 2 == hd
+    pages_per_slot = page_table.shape[1]
     has_vf = valid_from is not None
     pad_g = (-g) % 8
     if pad_g:
@@ -166,29 +189,33 @@ def _paged_impl(q, k_pool, v_pool, k_scales, v_scales, page_table, index,
         kvh,
     )
     sm_scale = 1.0 / (hd ** 0.5)
+    bps = -(-pages_per_slot // split)  # pages per split (last may be ragged)
+
+    def blk(bh, *js):
+        if split == 1:
+            (j,) = js
+            return j
+        s_id, j = js
+        # Ragged tail clamps to a valid table column (masked in-kernel).
+        return jnp.minimum(s_id * bps + j, pages_per_slot - 1)
 
     # Scalar-prefetch operand 0: the page table, flattened with the idx /
     # valid_from vectors appended is NOT needed — table stays 2-D; the
     # kernel's SMEM scalars (idx, vf) remain ordinary SMEM inputs.
-    def q_map(bh, j, table_ref):
-        del j, table_ref
+    def q_map(bh, *js_table):
         return (bh, 0, 0)
 
-    def kv_map(bh, j, table_ref):
-        return (table_ref[bh // kvh, j], bh % kvh, 0, 0)
+    def kv_map(bh, *js_table):
+        *js, table_ref = js_table
+        return (table_ref[bh // kvh, blk(bh, *js)], bh % kvh, 0, 0)
 
-    def smem_map(bh, j, table_ref):
-        del j, table_ref
+    def smem_map(bh, *js_table):
         return (bh,)
-
-    def out_map(bh, j, table_ref):
-        del j, table_ref
-        return (bh, 0, 0)
 
     in_specs = [
         pl.BlockSpec((1, gq, hd), q_map, memory_space=_VMEM),
-        pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
-        pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, page, hdk), kv_map, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, page, hdk), kv_map, memory_space=_VMEM),
         pl.BlockSpec((1,), smem_map, memory_space=pltpu.SMEM),
     ]
     operands = [qf, k_pool, v_pool, idx]
@@ -214,51 +241,103 @@ def _paged_impl(q, k_pool, v_pool, k_scales, v_scales, page_table, index,
             pl.BlockSpec((1,), smem_map, memory_space=pltpu.SMEM)
         )
 
+    on_tpu = jax.default_backend() == "tpu"
+    scratch = [
+        pltpu.VMEM((gq, 1), jnp.float32),
+        pltpu.VMEM((gq, 1), jnp.float32),
+        pltpu.VMEM((gq, hd), jnp.float32),
+    ]
+    if split == 1:
+        kernel = functools.partial(
+            _paged_kernel,
+            block_k=page,
+            num_kv=pages_per_slot,
+            sm_scale=sm_scale,
+            quantized=quantized,
+            has_vf=has_vf,
+            packed=packed,
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * kvh, pages_per_slot),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, gq, hd), q_map, memory_space=_VMEM),
+            scratch_shapes=scratch,
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b * kvh, gq, hd), q.dtype),
+            compiler_params=(
+                pltpu.CompilerParams(
+                    dimension_semantics=("parallel", "arbitrary")
+                )
+                if on_tpu
+                else None
+            ),
+            interpret=not on_tpu,
+        )(jnp.asarray(page_table, jnp.int32), *operands)
+        return out.reshape(b, kvh, gq, hd)[:, :, :g, :]
+
+    # Flash-decoding split over the slot's page list: each (row, split)
+    # streams its own run of table entries and emits partials; the
+    # single-pass rescale combine reduces them (dense discipline).
+    def part_map(bh, s_id, j, table_ref):
+        del j, table_ref
+        return (bh, s_id, 0, 0)
+
     kernel = functools.partial(
-        _paged_kernel,
+        _paged_split_kernel,
         block_k=page,
         num_kv=pages_per_slot,
+        bps=bps,
         sm_scale=sm_scale,
         quantized=quantized,
         has_vf=has_vf,
+        packed=packed,
     )
-    on_tpu = jax.default_backend() == "tpu"
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b * kvh, pages_per_slot),
+        grid=(b * kvh, split, bps),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, gq, hd), out_map, memory_space=_VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((gq, 1), jnp.float32),
-            pltpu.VMEM((gq, 1), jnp.float32),
-            pltpu.VMEM((gq, hd), jnp.float32),
-        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, gq, hd), part_map, memory_space=_VMEM),
+            pl.BlockSpec((1, 1, gq, hd), part_map, memory_space=_VMEM),
+            pl.BlockSpec((1, 1, gq, hd), part_map, memory_space=_VMEM),
+        ),
+        scratch_shapes=scratch,
     )
-    out = pl.pallas_call(
+    o_p, m_p, l_p = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b * kvh, gq, hd), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * kvh, split, gq, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b * kvh, split, gq, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b * kvh, split, gq, hd), jnp.float32),
+        ),
         compiler_params=(
             pltpu.CompilerParams(
-                dimension_semantics=("parallel", "arbitrary")
+                dimension_semantics=("parallel", "parallel", "arbitrary")
             )
             if on_tpu
             else None
         ),
         interpret=not on_tpu,
     )(jnp.asarray(page_table, jnp.int32), *operands)
+    out = _combine_splits(o_p, m_p, l_p, q.dtype)
     return out.reshape(b, kvh, gq, hd)[:, :, :g, :]
 
 
 def _paged_kernel(table_ref, q_ref, k_ref, v_ref, idx_ref, *refs, block_k,
-                  num_kv, sm_scale, quantized, has_vf):
+                  num_kv, sm_scale, quantized, has_vf, packed=False):
     """Scalar-prefetch wrapper: the table ref arrives first (consumed by
     the index_maps, unused in the body) and the K/V tiles arrive as
     (1, 1, page, hd) — drop the head axis and delegate to the contiguous
     decode kernel body (one attention discipline, two layouts).
     Quantized pools add chunked (1, 1, page/128, 128) f32 scale tiles,
     table-addressed like the int8 payload; ``_decode_kernel``'s quantized branch applies
-    them to the score/probability columns in VMEM — the fused dequant."""
+    them to the score/probability columns in VMEM — the fused dequant
+    (``packed``: int4 nibble pools, unpacked there too)."""
     del table_ref
     _decode_kernel(
         q_ref,
@@ -271,12 +350,36 @@ def _paged_kernel(table_ref, q_ref, k_ref, v_ref, idx_ref, *refs, block_k,
         sm_scale=sm_scale,
         quantized=quantized,
         has_vf=has_vf,
+        packed=packed,
+    )
+
+
+def _paged_split_kernel(table_ref, q_ref, k_ref, v_ref, idx_ref, *refs,
+                        block_k, num_kv, bps, sm_scale, quantized, has_vf,
+                        packed=False):
+    """Flash-split scalar-prefetch wrapper: grid (b * kv_h, split, bps)
+    — drop the table/head axes and delegate to the dense split kernel
+    (partial emission + masked ragged tail)."""
+    del table_ref
+    _decode_split_kernel(
+        q_ref,
+        k_ref.at[:, 0],
+        v_ref.at[:, 0],
+        idx_ref,
+        *refs,
+        block_k=block_k,
+        num_kv=num_kv,
+        bps=bps,
+        sm_scale=sm_scale,
+        quantized=quantized,
+        has_vf=has_vf,
+        packed=packed,
     )
 
 
 def _chunk_kernel(pages_ref, q_ref, k_ref, v_ref, pos0_ref, *refs,
                   block_k, num_kv, sm_scale, chunk, window=None,
-                  quantized=False):
+                  quantized=False, packed=False):
     """Chunk-query paged attention: q rows are a CHUNK of positions
     [pos0, pos0 + chunk) (GQA groups folded in, row = member*chunk + p)
     attending the paged window up to each row's own position — the
@@ -285,7 +388,8 @@ def _chunk_kernel(pages_ref, q_ref, k_ref, v_ref, pos0_ref, *refs,
     scratch, exactly the decode kernel's discipline with a row-dependent
     diagonal instead of a shared index. Quantized pools add chunked
     (page/128, 128) f32 scale tiles applied to the score/probability
-    columns in VMEM (``_decode_kernel``'s fused-dequant discipline)."""
+    columns in VMEM (``_decode_kernel``'s fused-dequant discipline;
+    ``packed`` int4 pools unpack their nibbles there too)."""
     del pages_ref  # consumed by the index_maps
     refs = list(refs)
     ksc_ref = refs.pop(0) if quantized else None
@@ -296,25 +400,9 @@ def _chunk_kernel(pages_ref, q_ref, k_ref, v_ref, pos0_ref, *refs,
 
     @pl.when(j == 0)
     def _init():
-        m_scr[...] = jnp.full(m_scr.shape, -1e30, jnp.float32)
-        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
-        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+        _init_softmax_scratch(m_scr, l_scr, acc_scr)
 
     def _step():
-        q = q_ref[0].astype(jnp.float32)  # (gc, hd)
-        k = k_ref[0, 0].astype(jnp.float32)  # (block_k, hd)
-        v = v_ref[0, 0].astype(jnp.float32)
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * sm_scale
-        )  # (gc, block_k)
-        if quantized:
-            # One f32 scale per column of this page: factors out of the
-            # per-vector dot, applied to the small score row.
-            s = s * ksc_ref[0, 0].reshape(1, block_k)
         rows = jax.lax.broadcasted_iota(jnp.int32, (gc, block_k), 0) % chunk
         cols = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (gc, block_k), 1
@@ -326,17 +414,11 @@ def _chunk_kernel(pages_ref, q_ref, k_ref, v_ref, pos0_ref, *refs,
             live = jnp.logical_and(
                 live, cols > pos0_ref[0] + rows - window
             )
-        s = jnp.where(live, s, -1e30)
-        m = m_scr[...]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        m_scr[...] = m_new
-        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = p * vsc_ref[0, 0].reshape(1, block_k) if quantized else p
-        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            pv, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        _attend_tile(
+            q_ref[0], k_ref[0, 0], v_ref[0, 0],
+            ksc_ref[0, 0].reshape(1, block_k) if quantized else None,
+            vsc_ref[0, 0].reshape(1, block_k) if quantized else None,
+            live, m_scr, l_scr, acc_scr, sm_scale, packed,
         )
 
     # Pages entirely past the chunk's last position are dead (the pow2
@@ -373,10 +455,12 @@ def paged_chunk_attention_reference(q, k_pool, v_pool, pages, pos0,
             1, kvh, -1, pool.shape[3]
         )
 
-    sm = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    sm = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
     if quantized:
         k, ksc = gather(k_pool[0]), gather(k_pool[1])
         v, vsc = gather(v_pool[0]), gather(v_pool[1])
+        if k.shape[-1] * 2 == q.shape[-1]:  # packed int4 nibbles
+            k, v = unpack_int4(k), unpack_int4(v)
         s = jnp.einsum(
             "bhqd,bhkd->bhqk",
             q.astype(jnp.float32),
@@ -406,8 +490,10 @@ def _chunk_impl(q, k_pool, v_pool, k_scales, v_scales, pages, pos0, chunk,
                 window=None):
     _, kvh, gc, hd = q.shape
     page = k_pool.shape[2]
+    hdk = k_pool.shape[3]  # head_dim // 2 for packed int4 pools
     n = pages.shape[0]
     quantized = k_scales is not None
+    packed = quantized and hdk * 2 == hd
     pad_g = (-gc) % 8
     if pad_g:
         q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_g), (0, 0)))
@@ -428,8 +514,8 @@ def _chunk_impl(q, k_pool, v_pool, k_scales, v_scales, pages, pos0, chunk,
 
     in_specs = [
         pl.BlockSpec((1, gcp, hd), q_map, memory_space=_VMEM),
-        pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
-        pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, page, hdk), kv_map, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, page, hdk), kv_map, memory_space=_VMEM),
         pl.BlockSpec((1,), smem_map, memory_space=pltpu.SMEM),
     ]
     operands = [qf, k_pool, v_pool, pos0v]
@@ -467,6 +553,7 @@ def _chunk_impl(q, k_pool, v_pool, k_scales, v_scales, pages, pos0, chunk,
             chunk=chunk,
             window=window,
             quantized=quantized,
+            packed=packed,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((kvh, gcp, hd), q.dtype),
@@ -518,15 +605,18 @@ def paged_chunk_attention(
             f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
         )
     if prefer == "pallas" and supported:
+        record_kernel_dispatch("paged_chunk", "pallas")
         kv, vv, ks, vs = _split_pools(k_pool, v_pool)
         return _chunk_impl(q, kv, vv, ks, vs, pages, pos0, chunk, window)
+    record_kernel_dispatch("paged_chunk", "xla")
     return paged_chunk_attention_reference(
         q, k_pool, v_pool, pages, pos0, chunk, window
     )
 
 
 def paged_verify_attention_reference(q, k_pool, v_pool, page_table, index,
-                                     chunk: int, window: int | None = None):
+                                     chunk: int, window: int | None = None,
+                                     tree_tail: int = 0):
     """jnp oracle for the batched paged VERIFY: gather each slot's pages
     into a contiguous window and run the contiguous verify oracle
     (``ops/decode_attention.verify_attention``, which owns the
@@ -549,13 +639,14 @@ def paged_verify_attention_reference(q, k_pool, v_pool, page_table, index,
     else:
         cache_k, cache_v = gather(k_pool), gather(v_pool)
     return verify_attention(
-        q, cache_k, cache_v, index, chunk, window=window
+        q, cache_k, cache_v, index, chunk, window=window,
+        tree_tail=tree_tail,
     )
 
 
 def _verify_kernel(table_ref, q_ref, k_ref, v_ref, idx_ref, *refs,
                    block_k, num_kv, sm_scale, chunk, window=None,
-                   quantized=False):
+                   quantized=False, packed=False, tree_tail=0, bps=None):
     """Batched chunk-query paged attention: one (batch, kv_head) row of
     K-major verify rows streams ITS page-table row innermost (scalar
     prefetch, as ``_paged_kernel``) with ``_chunk_kernel``'s per-row
@@ -563,78 +654,95 @@ def _verify_kernel(table_ref, q_ref, k_ref, v_ref, idx_ref, *refs,
     (``idx_ref`` SMEM) — the speculative verify over a paged cache.
     Dead rows (negative index) skip every block and emit zeros.
     Quantized pools add chunked (page/128, 128) f32 scale tiles applied to the
-    score/probability columns in VMEM (the fused dequant)."""
+    score/probability columns in VMEM (the fused dequant; ``packed``
+    int4 pools unpack their nibbles there). ``tree_tail`` = w marks the
+    chunk's last w rows as TREE LEAVES: each attends the chain prefix
+    (depth ``chunk - 1 - w``) plus its OWN physical slot only — the
+    tree-draft verify mask (``ops.decode_attention.verify_attention``).
+    ``bps`` non-None selects the FLASH-SPLIT grid (b * kv_h, split,
+    bps): partial (acc, m, l) emission per split with the caller's
+    rescale combine, the ``_decode_split_kernel`` discipline."""
     del table_ref  # consumed by the index_maps
+    split_mode = bps is not None
     refs = list(refs)
     ksc_ref = refs.pop(0) if quantized else None
     vsc_ref = refs.pop(0) if quantized else None
-    o_ref, m_scr, l_scr, acc_scr = refs
-    j = pl.program_id(1)
+    if split_mode:
+        o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = refs
+        j = pl.program_id(2)
+        jg = pl.program_id(1) * bps + j  # global page index (clamped map)
+        last_j = bps - 1
+    else:
+        o_ref, m_scr, l_scr, acc_scr = refs
+        j = pl.program_id(1)
+        jg = j
+        last_j = num_kv - 1
     gc = q_ref.shape[1]
 
     @pl.when(j == 0)
     def _init():
-        m_scr[...] = jnp.full(m_scr.shape, -1e30, jnp.float32)
-        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
-        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+        _init_softmax_scratch(m_scr, l_scr, acc_scr)
 
     def _step():
-        q = q_ref[0].astype(jnp.float32)  # (gc, hd)
-        k = k_ref[0, 0].astype(jnp.float32)  # (block_k, hd)
-        v = v_ref[0, 0].astype(jnp.float32)
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * sm_scale
-        )  # (gc, block_k)
-        if quantized:
-            s = s * ksc_ref[0, 0].reshape(1, block_k)
         rows = jax.lax.broadcasted_iota(jnp.int32, (gc, block_k), 0) % chunk
-        cols = j * block_k + jax.lax.broadcasted_iota(
+        cols = jg * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (gc, block_k), 1
         )
-        live = cols <= idx_ref[0] + rows
+        if tree_tail:
+            depth = jnp.minimum(rows, chunk - 1 - tree_tail)
+        else:
+            depth = rows
+        live = cols <= idx_ref[0] + depth
         if window is not None:
-            live = jnp.logical_and(live, cols > idx_ref[0] + rows - window)
-        s = jnp.where(live, s, -1e30)
-        m = m_scr[...]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        m_scr[...] = m_new
-        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = p * vsc_ref[0, 0].reshape(1, block_k) if quantized else p
-        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            pv, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            live = jnp.logical_and(live, cols > idx_ref[0] + depth - window)
+        if tree_tail:
+            # A leaf row's own physical slot is live even though it sits
+            # past the chain edge; siblings' slots stay masked.
+            live = jnp.logical_or(live, cols == idx_ref[0] + rows)
+        _attend_tile(
+            q_ref[0], k_ref[0, 0], v_ref[0, 0],
+            ksc_ref[0, 0].reshape(1, block_k) if quantized else None,
+            vsc_ref[0, 0].reshape(1, block_k) if quantized else None,
+            live, m_scr, l_scr, acc_scr, sm_scale, packed,
         )
 
     # Pages wholly past this slot's last chunk position are dead (every
     # page, for a negative dead-row index); under a sliding window so
-    # are pages wholly below row 0's window.
-    live_block = j * block_k <= idx_ref[0] + chunk - 1
+    # are pages wholly below row 0's window. The ragged split tail's
+    # clamped pages mask here too (jg >= num_kv).
+    live_block = jg * block_k <= idx_ref[0] + chunk - 1
+    if split_mode:
+        live_block = jnp.logical_and(live_block, jg < num_kv)
     if window is not None:
         live_block = jnp.logical_and(
-            live_block, (j + 1) * block_k - 1 > idx_ref[0] - window
+            live_block, (jg + 1) * block_k - 1 > idx_ref[0] - window
         )
     pl.when(live_block)(_step)
 
-    @pl.when(j == num_kv - 1)
+    @pl.when(j == last_j)
     def _emit():
-        o_ref[0] = (
-            acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
-        ).astype(o_ref.dtype)
+        if split_mode:
+            hd = o_ref.shape[-1]
+            o_ref[0, 0] = acc_scr[...]
+            m_ref[0, 0] = jnp.broadcast_to(m_scr[...], (gc, hd))
+            l_ref[0, 0] = jnp.broadcast_to(l_scr[...], (gc, hd))
+        else:
+            o_ref[0] = (
+                acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+            ).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("chunk", "window"))
+@functools.partial(
+    jax.jit, static_argnames=("chunk", "window", "tree_tail", "split")
+)
 def _verify_impl(q, k_pool, v_pool, k_scales, v_scales, page_table, index,
-                 chunk, window=None):
+                 chunk, window=None, tree_tail=0, split=1):
     b, kvh, gc, hd = q.shape
     page = k_pool.shape[2]
+    hdk = k_pool.shape[3]  # head_dim // 2 for packed int4 pools
     pages_per_slot = page_table.shape[1]
     quantized = k_scales is not None
+    packed = quantized and hdk * 2 == hd
     pad_g = (-gc) % 8
     if pad_g:
         q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_g), (0, 0)))
@@ -644,22 +752,29 @@ def _verify_impl(q, k_pool, v_pool, k_scales, v_scales, page_table, index,
         jnp.broadcast_to(jnp.asarray(index, jnp.int32).reshape(-1), (b,)),
         kvh,
     )
+    bps = -(-pages_per_slot // split)
 
-    def q_map(bh, j, table_ref):
-        del j, table_ref
+    def blk(bh, *js):
+        if split == 1:
+            (j,) = js
+            return j
+        s_id, j = js
+        return jnp.minimum(s_id * bps + j, pages_per_slot - 1)
+
+    def q_map(bh, *js_table):
         return (bh, 0, 0)
 
-    def kv_map(bh, j, table_ref):
-        return (table_ref[bh // kvh, j], bh % kvh, 0, 0)
+    def kv_map(bh, *js_table):
+        *js, table_ref = js_table
+        return (table_ref[bh // kvh, blk(bh, *js)], bh % kvh, 0, 0)
 
-    def smem_map(bh, j, table_ref):
-        del j, table_ref
+    def smem_map(bh, *js_table):
         return (bh,)
 
     in_specs = [
         pl.BlockSpec((1, gcp, hd), q_map, memory_space=_VMEM),
-        pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
-        pl.BlockSpec((1, 1, page, hd), kv_map, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, page, hdk), kv_map, memory_space=_VMEM),
+        pl.BlockSpec((1, 1, page, hdk), kv_map, memory_space=_VMEM),
         pl.BlockSpec((1,), smem_map, memory_space=pltpu.SMEM),
     ]
     operands = [qf, k_pool, v_pool, idx]
@@ -675,18 +790,60 @@ def _verify_impl(q, k_pool, v_pool, k_scales, v_scales, page_table, index,
                 )
             )
     on_tpu = jax.default_backend() == "tpu"
+    scratch = [
+        pltpu.VMEM((gcp, 1), jnp.float32),
+        pltpu.VMEM((gcp, 1), jnp.float32),
+        pltpu.VMEM((gcp, hd), jnp.float32),
+    ]
+    if split == 1:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * kvh, pages_per_slot),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, gcp, hd), q_map, memory_space=_VMEM),
+            scratch_shapes=scratch,
+        )
+        out = pl.pallas_call(
+            functools.partial(
+                _verify_kernel,
+                block_k=page,
+                num_kv=pages_per_slot,
+                sm_scale=1.0 / (hd ** 0.5),
+                chunk=chunk,
+                window=window,
+                quantized=quantized,
+                packed=packed,
+                tree_tail=tree_tail,
+            ),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b * kvh, gcp, hd), q.dtype),
+            compiler_params=(
+                pltpu.CompilerParams(
+                    dimension_semantics=("parallel", "arbitrary")
+                )
+                if on_tpu
+                else None
+            ),
+            interpret=not on_tpu,
+        )(jnp.asarray(page_table, jnp.int32), *operands)
+        return out.reshape(b, kvh, gcp, hd)[:, :, :gc, :]
+
+    def part_map(bh, s_id, j, table_ref):
+        del j, table_ref
+        return (bh, s_id, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b * kvh, pages_per_slot),
+        grid=(b * kvh, split, bps),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, gcp, hd), q_map, memory_space=_VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((gcp, 1), jnp.float32),
-            pltpu.VMEM((gcp, 1), jnp.float32),
-            pltpu.VMEM((gcp, hd), jnp.float32),
-        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, gcp, hd), part_map, memory_space=_VMEM),
+            pl.BlockSpec((1, 1, gcp, hd), part_map, memory_space=_VMEM),
+            pl.BlockSpec((1, 1, gcp, hd), part_map, memory_space=_VMEM),
+        ),
+        scratch_shapes=scratch,
     )
-    out = pl.pallas_call(
+    o_p, m_p, l_p = pl.pallas_call(
         functools.partial(
             _verify_kernel,
             block_k=page,
@@ -695,18 +852,26 @@ def _verify_impl(q, k_pool, v_pool, k_scales, v_scales, page_table, index,
             chunk=chunk,
             window=window,
             quantized=quantized,
+            packed=packed,
+            tree_tail=tree_tail,
+            bps=bps,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b * kvh, gcp, hd), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * kvh, split, gcp, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b * kvh, split, gcp, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b * kvh, split, gcp, hd), jnp.float32),
+        ),
         compiler_params=(
             pltpu.CompilerParams(
-                dimension_semantics=("parallel", "arbitrary")
+                dimension_semantics=("parallel", "parallel", "arbitrary")
             )
             if on_tpu
             else None
         ),
         interpret=not on_tpu,
     )(jnp.asarray(page_table, jnp.int32), *operands)
+    out = _combine_splits(o_p, m_p, l_p, q.dtype)
     return out.reshape(b, kvh, gcp, hd)[:, :, :gc, :]
 
 
@@ -719,6 +884,8 @@ def paged_verify_attention(
     chunk: int,
     prefer: str | None = None,
     window: int | None = None,
+    tree_tail: int = 0,
+    split: int | None = None,
 ) -> jax.Array:
     """Batched multi-token verify attention over a paged KV cache — the
     speculative-decode counterpart of :func:`paged_attention` (K chunk
@@ -727,7 +894,11 @@ def paged_verify_attention(
 
     Pools are native arrays or quantized ``(int8 values, f32 scales)``
     pairs (the caller scattered the chunk's quantized K/V into BOTH
-    members). Dispatch as :func:`paged_attention`: the scalar-prefetch
+    members; int4-PACKED pairs carry ``head_dim // 2`` nibble lanes).
+    ``tree_tail`` marks the chunk's last w rows as tree-draft leaves
+    (``decode_attention.verify_attention``'s mask). ``split`` is the
+    flash-decoding page-axis split (None = auto on TPU, 1 off-TPU).
+    Dispatch as :func:`paged_attention`: the scalar-prefetch
     kernel on a real TPU with lane-multiple pages (the gather oracle
     materializes every slot's whole window — the traffic paging exists
     to avoid), the oracle everywhere else. Grids and the GQA fold
@@ -749,12 +920,16 @@ def paged_verify_attention(
             f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
         )
     if prefer == "pallas" and supported:
+        split = resolve_decode_split(page_table.shape[1], split)
+        record_kernel_dispatch("paged_verify", "pallas")
         kv, vv, ks, vs = _split_pools(k_pool, v_pool)
         return _verify_impl(
-            q, kv, vv, ks, vs, page_table, index, chunk, window
+            q, kv, vv, ks, vs, page_table, index, chunk, window,
+            tree_tail, split,
         )
+    record_kernel_dispatch("paged_verify", "xla")
     return paged_verify_attention_reference(
-        q, k_pool, v_pool, page_table, index, chunk, window
+        q, k_pool, v_pool, page_table, index, chunk, window, tree_tail
     )
 
 
@@ -766,6 +941,7 @@ def paged_attention(
     index,
     valid_from=None,
     prefer: str | None = None,
+    split: int | None = None,
 ) -> jax.Array:
     """Decode attention over a paged KV cache.
 
@@ -778,7 +954,11 @@ def paged_attention(
     window, the exact traffic paging exists to avoid), the oracle
     everywhere else (off-TPU the kernel only has the Pallas INTERPRETER,
     orders of magnitude slower than XLA's gather — tests opt in with
-    ``prefer="pallas"``). ``"pallas"`` / ``"xla"`` force. Grids/folds
+    ``prefer="pallas"``). ``"pallas"`` / ``"xla"`` force. ``split`` is
+    the flash-decoding split along the slot's page list (None = auto:
+    ``decode_attention.default_decode_split`` of pages_per_slot on a
+    real TPU, 1 off-TPU; 1 = the original single-stream kernel,
+    bit-exact). Grids/folds
     derive from the given (per-shard, under TP) head count — q and pool
     must agree (``decode_attention.check_head_parity``)."""
     quantized = isinstance(k_pool, tuple)
@@ -793,10 +973,13 @@ def paged_attention(
             f"prefer={prefer!r}: expected None, 'pallas' or 'xla'"
         )
     if prefer == "pallas" and supported:
+        split = resolve_decode_split(page_table.shape[1], split)
+        record_kernel_dispatch("paged_decode", "pallas")
         kv, vv, ks, vs = _split_pools(k_pool, v_pool)
         return _paged_impl(
-            q, kv, vv, ks, vs, page_table, index, valid_from
+            q, kv, vv, ks, vs, page_table, index, valid_from, split
         )
+    record_kernel_dispatch("paged_decode", "xla")
     return paged_attention_reference(
         q, k_pool, v_pool, page_table, index, valid_from
     )

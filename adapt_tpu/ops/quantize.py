@@ -152,23 +152,69 @@ def dequantize(qt: QuantizedTensor) -> jax.Array:
     return out.reshape(-1)[:size].reshape(qt.shape).astype(qt.dtype)
 
 
-def quantize_kv_vectors(t: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Per-vector absmax int8 over the trailing (head_dim) axis — THE
-    KV-cache quantization scheme (one f32 scale per cached key/value
-    vector), shared by ``CausalSelfAttention``, the decode-attention
-    kernel tests and the on-chip smoke so the definition cannot fork.
-    Returns ``(int8 values, f32 scales with keepdims)``."""
+def pack_int4(q: jax.Array) -> jax.Array:
+    """Pack an even-width trailing axis of int values in [-8, 7] into
+    int8 bytes, two NIBBLES per lane: element ``2i`` lands in the low
+    nibble of byte ``i``, element ``2i + 1`` in the high nibble — the
+    int4 KV pool layout (the HBM stream is half the int8 bytes).
+    Returns ``(..., w // 2)`` int8."""
+    q = q.astype(jnp.int32)
+    lo, hi = q[..., 0::2], q[..., 1::2]
+    p = (lo & 15) | ((hi & 15) << 4)
+    # Explicit two's-complement wrap before the int8 cast: the packed
+    # byte pattern is what matters, not its signed value.
+    return jnp.where(p >= 128, p - 256, p).astype(jnp.int8)
+
+
+def unpack_int4(packed: jax.Array) -> jax.Array:
+    """Inverse of :func:`pack_int4`: ``(..., w)`` int8 packed bytes ->
+    ``(..., 2w)`` int32 nibble values in [-8, 7], interleaved back into
+    element order. Pure lane arithmetic (mask / shift / stack), so the
+    Pallas kernels run it in VMEM on the streamed int8 tile — the fused
+    int4 dequant's unpack half."""
+    p = packed.astype(jnp.int32)
+    lo = ((p & 15) ^ 8) - 8  # sign-extend the low nibble
+    hi = p >> 4  # arithmetic shift sign-extends the high nibble
+    return jnp.stack([lo, hi], axis=-1).reshape(
+        p.shape[:-1] + (p.shape[-1] * 2,)
+    )
+
+
+def quantize_kv_vectors(
+    t: jax.Array, dtype: str = "int8"
+) -> tuple[jax.Array, jax.Array]:
+    """Per-vector absmax quantization over the trailing (head_dim) axis
+    — THE KV-cache quantization scheme (one f32 scale per cached
+    key/value vector), shared by ``CausalSelfAttention``, the
+    decode-attention kernel tests and the on-chip smoke so the
+    definition cannot fork.
+
+    ``dtype="int8"`` returns ``(int8 values, f32 scales with
+    keepdims)``. ``dtype="int4"`` quantizes to the 15-level [-7, 7]
+    lattice and PACKS two nibbles per int8 lane (:func:`pack_int4`) —
+    values ``(..., head_dim // 2)`` int8, scales unchanged — so the
+    resident bytes are 4-bit while the scale plane keeps the int8
+    layout (page tables, head sharding and handoff plans see the same
+    pytree shape discipline)."""
+    if dtype not in ("int8", "int4"):
+        raise ValueError(
+            f"dtype={dtype!r}: expected 'int8' or 'int4'"
+        )
+    qmax = 127.0 if dtype == "int8" else 7.0
     scale = (
         jnp.max(jnp.abs(t.astype(jnp.float32)), axis=-1, keepdims=True)
-        / 127.0
+        / qmax
     )
     scale = jnp.maximum(scale, 1e-8)
-    vals = (
-        jnp.round(t.astype(jnp.float32) / scale)
-        .clip(-127, 127)
-        .astype(jnp.int8)
-    )
-    return vals, scale
+    vals = jnp.round(t.astype(jnp.float32) / scale).clip(-qmax, qmax)
+    if dtype == "int4":
+        if t.shape[-1] % 2:
+            raise ValueError(
+                f"int4 KV packing needs an even head_dim, got "
+                f"{t.shape[-1]}"
+            )
+        return pack_int4(vals), scale
+    return vals.astype(jnp.int8), scale
 
 
 def quantize_params(tree):

@@ -263,6 +263,7 @@ from jax.sharding import (
 )
 
 from adapt_tpu.config import (
+    KernelConfig,
     ParallelConfig,
     RecoveryConfig,
     SchedulerConfig,
@@ -458,6 +459,7 @@ class ContinuousBatcher:
         health=None,
         journal=None,
         scheduler: SchedulerConfig | None = None,
+        kernel: KernelConfig | None = None,
     ):
         self.lm = lm
         # -- tensor parallelism (mesh-native serving) ----------------------
@@ -587,24 +589,38 @@ class ContinuousBatcher:
         self._spec_k_granted = {self._spec_k}
         self._draft_lm = draft_lm
         self._draft_variables = draft_variables
-        if kv_cache_dtype not in ("native", "int8"):
+        #: TREE-DRAFT width (``SpeculativeConfig.tree_width``): 0 =
+        #: chain speculation; w >= 1 adds w sibling leaf rows to every
+        #: verify chunk and up to ONE bonus committed token per round
+        #: (the leaf + the target's prediction after it). Geometry
+        #: below (cache slack, table width, idle sentinel, admission
+        #: reservation) all widen by w so leaf writes land in reserved
+        #: masked space.
+        self._spec_w = self._spec.tree_width if self._spec else 0
+        #: Decode-kernel dispatch knobs threaded into every decode/
+        #: verify program this batcher lowers (static per batcher —
+        #: the jit families key on self).
+        self._kernel = kernel or KernelConfig()
+        if kv_cache_dtype not in ("native", "int8", "int4"):
             raise ValueError(
-                f"kv_cache_dtype={kv_cache_dtype!r}: expected 'native' "
-                "or 'int8'"
+                f"kv_cache_dtype={kv_cache_dtype!r}: expected 'native', "
+                "'int8' or 'int4'"
             )
         if kv_layout not in ("slots", "paged"):
             raise ValueError(
                 f"kv_layout={kv_layout!r}: expected 'slots' or 'paged'"
             )
-        #: int8 KV caches: absmax per K/V vector, same scheme as
-        #: generate(kv_cache_dtype="int8") — ~2-4x more resident context
-        #: per slot and ~2-4x less per-step cache traffic vs native.
-        #: Composes with EVERY layout and mode: dense strips and paged
-        #: pools both become (int8 values, f32 scales) pytree pairs,
+        #: Quantized KV caches: absmax per K/V vector, same scheme as
+        #: generate(kv_cache_dtype=...) — ~2-4x (int8) / ~4-8x (int4,
+        #: two nibbles packed per int8 lane) more resident context per
+        #: slot and correspondingly less per-step cache traffic vs
+        #: native. Composes with EVERY layout and mode: dense strips
+        #: and paged pools both become (values, scales) pytree pairs,
         #: speculative verify quantizes its multi-token appends, and
         #: under TP both members head-shard together — quantization is
         #: a cache-layout property, not a special mode of one path.
-        self._kv_quant = kv_cache_dtype == "int8"
+        self._kv_dtype = kv_cache_dtype
+        self._kv_quant = kv_cache_dtype != "native"
         #: paged caches: per-block page POOLS + a shared page table
         #: (``runtime/paged`` allocator, ``ops/paged_attention`` kernel)
         #: — HBM scales with resident tokens, not slots x max_len.
@@ -638,24 +654,36 @@ class ContinuousBatcher:
         #: Sliding-window models: decode masking lives in the model;
         #: the batcher's job is page RECYCLING behind the window.
         self._window = getattr(block0, "window", None)
-        # One trash slot for idle rows, plus draft_k SLACK positions in
-        # speculative mode: a verify chunk writes draft_k + 1 tokens
-        # from each slot's position (trash included), and the rejected
-        # overshoot must land in masked space, never shift onto live
-        # rows (append_kv clamps).
-        self._cache_len = lm.max_len + 1 + self._spec_k
+        # One trash slot for idle rows, plus draft_k (+ tree_width leaf
+        # rows) SLACK positions in speculative mode: a verify chunk
+        # writes draft_k + 1 + tree_width tokens from each slot's
+        # position (trash included), and the rejected overshoot must
+        # land in masked space, never shift onto live rows (append_kv
+        # clamps).
+        self._cache_len = lm.max_len + 1 + self._spec_k + self._spec_w
         self._trash = lm.max_len
         # Slot caches hold KV heads: fewer than query heads under GQA
         # (the whole point — slots cost kv_heads/heads the HBM).
         heads, head_dim = block0.cache_heads, block0.head_dim
+        if kv_cache_dtype == "int4" and head_dim % 2:
+            raise ValueError(
+                f"kv_cache_dtype='int4' packs two nibbles per int8 "
+                f"lane and needs an even head_dim, got {head_dim}"
+            )
+        #: VALUE-plane lane width: head_dim, halved for packed int4.
+        self._kv_width = (
+            head_dim // 2 if kv_cache_dtype == "int4" else head_dim
+        )
 
         if self._paged:
             if page_size < 1:
                 raise ValueError(f"page_size must be >= 1, got {page_size}")
             self._page = page_size
             # Table width covers max_len plus the speculative overshoot
-            # slack (verify writes reach position + draft_k).
-            pps = -(-(lm.max_len + self._spec_k) // page_size)
+            # slack (verify writes reach position + draft_k +
+            # tree_width).
+            pps = -(-(lm.max_len + self._spec_k + self._spec_w)
+                    // page_size)
             worst = slots * pps + 1  # every slot full + trash page
             if pool_pages is None:
                 pool_pages = worst
@@ -671,9 +699,12 @@ class ContinuousBatcher:
                     # (values, scales) POOL pair: the scale plane is one
                     # f32 per cached vector, page-addressed by the SAME
                     # table — prefix-shared pages carry their scales.
+                    # int4 pools halve the value plane's lane width
+                    # (two nibbles per int8 lane).
                     return (
                         jnp.zeros(
-                            (pool_pages, heads, page_size, head_dim),
+                            (pool_pages, heads, page_size,
+                             self._kv_width),
                             jnp.int8,
                         ),
                         jnp.zeros(
@@ -691,7 +722,8 @@ class ContinuousBatcher:
                 if self._kv_quant:
                     return (
                         jnp.zeros(
-                            (slots, heads, self._cache_len, head_dim),
+                            (slots, heads, self._cache_len,
+                             self._kv_width),
                             jnp.int8,
                         ),
                         jnp.zeros(
@@ -726,9 +758,14 @@ class ContinuousBatcher:
         #: Idle-row cache position: slot layout parks garbage writes at
         #: the trash strip; paged layout uses a negative sentinel that
         #: stays negative across a whole tick's position advance
-        #: (chunk steps, or the spec tick's up-to-draft_k+1 commit),
-        #: routing every garbage write to the trash page.
-        adv = (self._spec_k + 1) if self._spec else self.chunk
+        #: (chunk steps, or the spec tick's up-to-draft_k+1(+1 with a
+        #: tree-draft bonus) commit), routing every garbage write to
+        #: the trash page.
+        adv = (
+            (self._spec_k + 1 + (1 if self._spec_w else 0))
+            if self._spec
+            else self.chunk
+        )
         self._idle_pos = -(adv + 1) if self._paged else self._trash
         #: Draft-model slot caches (speculative mode): dense per-slot
         #: strips with the same draft_k + 1 slack as the single-request
@@ -741,7 +778,13 @@ class ContinuousBatcher:
                 for n in draft_lm.block_names
             ]
             self._draft_embed = draft_lm.graph.node("embed").module
-            dclen = draft_lm.max_len + self._spec_k + 1
+            # Tree drafts run one extra scan step (the leaf token's own
+            # cache write), so the draft strip carries one more slack
+            # position.
+            dclen = (
+                draft_lm.max_len + self._spec_k + 1
+                + (1 if self._spec_w else 0)
+            )
 
             def draft_cache():
                 return jnp.zeros(
@@ -1235,6 +1278,8 @@ class ContinuousBatcher:
                     kp, vp = cache
                     x, kp, vp = block.apply(
                         variables[name], x, kp, vp, table, pos, None,
+                        self._kernel.attn_impl,
+                        self._kernel.decode_split,
                         method="decode_step_paged",
                     )
                     new_caches.append((kp, vp))
@@ -1242,7 +1287,9 @@ class ContinuousBatcher:
                     ck, cv = cache
                     x, ck, cv = block.apply(
                         variables[name], x, ck, cv, pos, None,
-                        self._kv_quant, method="decode_step",
+                        self._kv_quant, self._kernel.attn_impl,
+                        self._kernel.decode_split,
+                        method="decode_step",
                     )
                     new_caches.append((ck, cv))
             logits = self._head.apply(variables["head"], x)[:, 0]  # (B, V)
@@ -1286,7 +1333,7 @@ class ContinuousBatcher:
         donate_argnums=(2, 3),
     )
     def _spec_verify(self, variables, caches, dstate, dtoks, table=None,
-                     *, epoch=0):
+                     cands=None, *, epoch=0):
         """The speculative tick's VERIFY program — the second of its
         exactly two compiled programs (the first is the shared
         ``models/speculative.draft_chunk`` scan).
@@ -1303,8 +1350,23 @@ class ContinuousBatcher:
         rows re-park at the idle sentinel; their writes are
         trash-routed by the verify primitives. Returns ((d+1, B)
         tokens, (d+1, B) logprobs, (B,) accepted counts, caches,
-        dstate)."""
+        dstate).
+
+        TREE DRAFTS (``cands`` (B, w) — the draft's top-w ids for the
+        position after the chain, ``SpeculativeConfig.tree_width``):
+        the chunk grows w LEAF rows verified in the same pass under the
+        tree mask. When a row's whole chain accepts AND its correction
+        token (the target's own pick for the leaf position) matches a
+        leaf, that leaf's cache entry is already written — the first
+        matching leaf's K/V moves to the canonical ``pos + d + 1`` slot
+        (one per-row gather/scatter per block; a no-op identity copy
+        when the match IS the first leaf) — and the target's prediction
+        AFTER that leaf commits as a BONUS token: up to d + 2 commits
+        per verify pass. Outputs then carry d + 2 token rows and
+        ``acc`` counts the bonus (commit limit stays ``acc + 1``)."""
         paged = table is not None
+        tree = cands is not None
+        w = cands.shape[1] if tree else 0
         caches = self._shard_kv(caches)
         dstate = self._repl_state(dstate)
         # The round's speculation depth comes from the DRAFT OUTPUT's
@@ -1312,15 +1374,23 @@ class ContinuousBatcher:
         # shrinks the effective draft_k at runtime (set_draft_k), and
         # each distinct depth is its own jit variant keyed by this
         # aval — reading the attribute would silently bake the
-        # construction-time value into every variant.
-        d = dtoks.shape[0] - 1
+        # construction-time value into every variant. (Tree rounds
+        # carry d + 2 draft rows: d proposals + the argmax leaf + the
+        # leaf-coverage step.)
+        d = dtoks.shape[0] - (2 if tree else 1)
+        kc = d + 1 + w  # verify chunk rows: chain + leaves
         tok, pos = dstate["tok"], dstate["pos"]
         active = dstate["active"]
         props = jnp.swapaxes(dtoks[:d], 0, 1)  # (B, d)
-        chunk = jnp.concatenate(
-            [tok[:, None], props.astype(tok.dtype)], axis=1
-        )  # (B, d+1)
-        pos_ids = pos[:, None] + jnp.arange(d + 1)[None, :]
+        parts = [tok[:, None], props.astype(tok.dtype)]
+        if tree:
+            parts.append(cands.astype(tok.dtype))  # (B, w) leaf rows
+        chunk = jnp.concatenate(parts, axis=1)  # (B, kc)
+        # Chain rows embed at their own offsets; leaf rows share the
+        # post-chain logical position d + 1 (their physical cache slots
+        # d + 1 .. d + w stay distinct — the tree mask's contract).
+        offs = jnp.minimum(jnp.arange(kc), d + 1)
+        pos_ids = pos[:, None] + offs[None, :]
         x = self._embed.apply(
             variables["embed"], chunk, pos_ids, method="embed_positions"
         )
@@ -1331,25 +1401,90 @@ class ContinuousBatcher:
             if paged:
                 kp, vp = cache
                 x, kp, vp = block.apply(
-                    variables[name], x, kp, vp, table, pos, None,
+                    variables[name], x, kp, vp, table, pos,
+                    self._kernel.attn_impl, w,
+                    self._kernel.decode_split,
                     method="verify_chunk_paged",
                 )
                 new_caches.append((kp, vp))
             else:
                 ck, cv = cache
                 x, ck, cv = block.apply(
-                    variables[name], x, ck, cv, pos,
+                    variables[name], x, ck, cv, pos, w,
                     method="verify_chunk",
                 )
                 new_caches.append((ck, cv))
-        logits = self._head.apply(variables["head"], x)  # (B, d+1, V)
+        logits = self._head.apply(variables["head"], x)  # (B, kc, V)
         preds = jnp.argmax(logits, axis=-1).astype(tok.dtype)
         lps = chosen_logprob(
             logits.reshape(-1, logits.shape[-1]), preds.reshape(-1)
-        ).reshape(preds.shape)  # (B, d+1)
-        acc = accept_speculation(props, preds)  # (B,)
+        ).reshape(preds.shape)  # (B, kc)
+        acc = accept_speculation(props, preds[:, : d + 1])  # (B,)
+        out_preds, out_lps = preds, lps
+        if tree:
+            # Bonus acceptance: full chain + correction token == a leaf
+            # candidate -> the leaf's K/V is in cache and the target's
+            # prediction after it commits too.
+            corr = preds[:, d]  # target's token for position pos + d + 1
+            match = cands.astype(corr.dtype) == corr[:, None]  # (B, w)
+            hit = jnp.logical_and(acc == d, jnp.any(match, axis=1))
+            s = jnp.argmax(match, axis=1)  # first matching leaf
+            leaf_row = d + 1 + s
+            bonus_tok = jnp.take_along_axis(
+                preds, leaf_row[:, None], axis=1
+            )[:, 0]
+            bonus_lp = jnp.take_along_axis(
+                lps, leaf_row[:, None], axis=1
+            )[:, 0]
+            out_preds = jnp.concatenate(
+                [preds[:, : d + 1], bonus_tok[:, None]], axis=1
+            )  # (B, d+2)
+            out_lps = jnp.concatenate(
+                [lps[:, : d + 1], bonus_lp[:, None]], axis=1
+            )
+            # Canonicalize the accepted leaf's cache entry: move leaf s
+            # from physical pos + d + 1 + s to pos + d + 1. Rows with
+            # s == 0, no hit, or inactive reduce to an identity
+            # self-copy at a safe position (dead rows target the trash
+            # page / trash strip — the ordinary garbage discipline).
+            do = jnp.logical_and(hit, jnp.logical_and(s > 0, active))
+            base = jnp.maximum(pos, 0) + d + 1
+            p_dst = jnp.where(do, base, 0)
+            p_src = jnp.where(do, base + s, 0)
+            if paged:
+                pg = self._page
+                phys_dst = jnp.take_along_axis(
+                    table, (p_dst // pg)[:, None], axis=1
+                )[:, 0]
+                phys_src = jnp.take_along_axis(
+                    table, (p_src // pg)[:, None], axis=1
+                )[:, 0]
+                off_dst, off_src = p_dst % pg, p_src % pg
+
+                def fix(pool):
+                    vec = pool[phys_src, :, off_src, :]  # (B, kvh, wd)
+                    return pool.at[phys_dst, :, off_dst, :].set(vec)
+
+            else:
+
+                def fix(cache):
+                    vec = jax.vmap(
+                        lambda c, i: lax.dynamic_slice(
+                            c, (0, i, 0), (c.shape[0], 1, c.shape[2])
+                        )
+                    )(cache, p_src)
+                    return jax.vmap(
+                        lambda c, v, i: lax.dynamic_update_slice(
+                            c, v, (0, i, 0)
+                        )
+                    )(cache, vec, p_dst)
+
+            new_caches = [
+                jax.tree.map(fix, pair) for pair in new_caches
+            ]
+            acc = acc + hit.astype(acc.dtype)
         ncommit = acc + 1
-        last = jnp.take_along_axis(preds, acc[:, None], axis=1)[:, 0]
+        last = jnp.take_along_axis(out_preds, acc[:, None], axis=1)[:, 0]
         # Optimistic device-side advance, exactly _step_chunk's
         # discipline: a surviving slot's entry invariants land on
         # pos + ncommit; retired slots are cleared host-side
@@ -1359,8 +1494,8 @@ class ContinuousBatcher:
         new["tok"] = jnp.where(active, last, 0)
         new["kbase"] = jnp.where(active, dstate["kbase"] + ncommit, 0)
         return (
-            jnp.swapaxes(preds, 0, 1),
-            jnp.swapaxes(lps, 0, 1),
+            jnp.swapaxes(out_preds, 0, 1),
+            jnp.swapaxes(out_lps, 0, 1),
             acc,
             self._shard_kv(new_caches),
             self._repl_state(new),
@@ -1397,7 +1532,7 @@ class ContinuousBatcher:
         return self._shard_kv(out)
 
     def adopt_prefill_pages(self, prompt, blocks, page_size: int,
-                            quantized: bool) -> int:
+                            quantized) -> int:
         """Land a disaggregated prefill's KV pages in this batcher's
         pool THROUGH THE PREFIX CACHE — the decode-side half of the
         ``runtime/disagg`` handoff. ``blocks`` is one ``(K, V)`` pair
@@ -1441,11 +1576,18 @@ class ContinuousBatcher:
                 f"handoff page size {page_size} != pool page size "
                 f"{self._page}"
             )
-        if quantized != self._kv_quant:
+        # ``quantized`` is the sender's kv dtype: a legacy bool (True =
+        # int8) or the dtype string — int4 handoffs must land in int4
+        # pools (the packed value width is part of the wire geometry).
+        sender_dt = (
+            quantized
+            if isinstance(quantized, str)
+            else ("int8" if quantized else "native")
+        )
+        if sender_dt != self._kv_dtype:
             raise ValueError(
-                f"handoff quantized={quantized} but pool "
-                f"kv_cache_dtype is "
-                f"{'int8' if self._kv_quant else 'native'}"
+                f"handoff kv dtype {sender_dt!r} but pool "
+                f"kv_cache_dtype is {self._kv_dtype!r}"
             )
         if len(blocks) != len(self._blocks):
             raise ValueError(
@@ -1478,7 +1620,14 @@ class ContinuousBatcher:
                     )
                 leaves = member if isinstance(member, tuple) else (member,)
                 for li, leaf in enumerate(leaves):
-                    width = block.head_dim if li == 0 else 1
+                    # Value plane carries the POOL's lane width (packed
+                    # for int4), the scale plane one f32 per vector.
+                    if li == 0:
+                        width = block.head_dim // (
+                            2 if self._kv_dtype == "int4" else 1
+                        )
+                    else:
+                        width = 1
                     want = (n, block.cache_heads, self._page, width)
                     if tuple(np.shape(leaf)) != want:
                         raise ValueError(
@@ -1586,7 +1735,8 @@ class ContinuousBatcher:
             kvs = []
             for name, block in zip(self.lm.block_names, self._blocks):
                 h, ck, cv = block.apply(
-                    variables[name], h, bucket, None, self._kv_quant,
+                    variables[name], h, bucket, None,
+                    self._kv_dtype if self._kv_quant else False,
                     method="prefill",
                 )
                 kvs.append((ck, cv))
@@ -3040,7 +3190,9 @@ class ContinuousBatcher:
                 # Speculative mode reserves draft_k SLACK pages: the
                 # verify chunk's rejected overshoot writes land there,
                 # masked, instead of off the end of the window.
-                span = max(bucket, s0 + req.steps + self._spec_k)
+                span = max(
+                    bucket, s0 + req.steps + self._spec_k + self._spec_w
+                )
                 n_pages = -(-span // P) - m
                 if not self._pager.alloc(i, n_pages):
                     self._pager.free_slot(i)  # releases the shares too
@@ -3357,6 +3509,7 @@ class ContinuousBatcher:
         sync. Returns host-side ((d+1, B) tokens, logprobs, (B,)
         per-slot commit limits)."""
         d = self._spec_k_eff
+        w = self._spec_w
         self._variants.setdefault("speculative.draft_chunk", set()).add(d)
         self._variants.setdefault("continuous.spec_verify", set()).add(d)
         eo = self._eobs
@@ -3371,14 +3524,33 @@ class ContinuousBatcher:
             tuple(s.req.req_id for s in active) if tracer.enabled else ()
         )
         t_draft = tracer.now() if tracer.enabled else 0.0
-        dtoks, self._draft_caches = draft_chunk(
-            self._draft_lm,
-            self._draft_variables,
-            self._dstate["tok"],
-            self._dstate["pos"],
-            self._draft_caches,
-            n=d + 1,
-        )
+        if w:
+            # Tree drafts: d chain steps + the argmax-leaf step + one
+            # leaf-coverage step (the leaf token's own draft-cache
+            # write), with the top-w leaf candidates harvested from
+            # logits the scan computes anyway (equal draft FLOPs per
+            # committed token). cands = the top-w ids of the step that
+            # predicts the post-chain position (scan index d).
+            dtoks, dtops, self._draft_caches = draft_chunk(
+                self._draft_lm,
+                self._draft_variables,
+                self._dstate["tok"],
+                self._dstate["pos"],
+                self._draft_caches,
+                n=d + 2,
+                tail_w=w,
+            )
+            cands = dtops[d]  # (B, w); cands[:, 0] == dtoks[d]
+        else:
+            cands = None
+            dtoks, self._draft_caches = draft_chunk(
+                self._draft_lm,
+                self._draft_variables,
+                self._dstate["tok"],
+                self._dstate["pos"],
+                self._draft_caches,
+                n=d + 1,
+            )
         if tracer.enabled:
             # Dispatch-side cost of the draft scan; the verify span
             # below carries the host sync. Tagged with the same request
@@ -3402,6 +3574,7 @@ class ContinuousBatcher:
             self._dstate,
             dtoks,
             self._current_table() if self._paged else None,
+            cands,
             epoch=self._mesh_epoch,
         )
         with self._cv:
@@ -3430,7 +3603,9 @@ class ContinuousBatcher:
         # lifetime counters follow).
         acc_counts = [int(acc[s.idx]) for s in active]
         with self._cv:
-            self._spec_drafted += d * len(active)
+            # Tree rounds draft d chain proposals + w leaf candidates
+            # per slot (acc counts a leaf hit as one more accepted).
+            self._spec_drafted += (d + w) * len(active)
             self._spec_accepted += sum(acc_counts)
             ratio = (
                 self._spec_accepted / self._spec_drafted
@@ -3844,11 +4019,21 @@ class ContinuousBatcher:
         try:
             if self._spec is not None:
                 a_dtoks = jax.ShapeDtypeStruct(
-                    (self._spec_k + 1, len(self.slots)), jnp.int32
+                    (self._spec_k + (2 if self._spec_w else 1),
+                     len(self.slots)),
+                    jnp.int32,
+                )
+                a_cands = (
+                    jax.ShapeDtypeStruct(
+                        (len(self.slots), self._spec_w), jnp.int32
+                    )
+                    if self._spec_w
+                    else None
                 )
                 costs["verify"] = program_cost_analysis(
                     type(self)._spec_verify,
                     self, a_vars, a_caches, a_dstate, a_dtoks, a_table,
+                    a_cands,
                     epoch=self._mesh_epoch,
                 )
             else:

@@ -139,6 +139,15 @@ class KVHandoff:
     quantized: bool
     #: One ``(K, V)`` pair per decoder block.
     blocks: list
+    #: KV dtype on the wire: "native", "int8" or "int4" (int4 members
+    #: carry PACKED ``head_dim // 2`` value lanes — the width is part
+    #: of the wire geometry the decode side validates). Defaults to
+    #: the legacy mapping of ``quantized``.
+    kv_dtype: str = ""
+
+    def __post_init__(self):
+        if not self.kv_dtype:
+            self.kv_dtype = "int8" if self.quantized else "native"
 
 
 def _leaves(handoff: KVHandoff) -> list[np.ndarray]:
@@ -182,6 +191,7 @@ def pack_handoff(handoff: KVHandoff) -> Message:
             "page_size": int(handoff.page_size),
             "n_pages": int(handoff.n_pages),
             "quantized": bool(handoff.quantized),
+            "kv_dtype": handoff.kv_dtype,
             "blocks": len(handoff.blocks),
             "prompt_len": int(handoff.prompt.shape[0]),
             "frame_lens": frame_lens,
@@ -245,6 +255,10 @@ def unpack_handoff(msg: Message) -> KVHandoff:
             n_pages=int(meta["n_pages"]),
             quantized=quantized,
             blocks=blocks,
+            kv_dtype=str(
+                meta.get("kv_dtype")
+                or ("int8" if quantized else "native")
+            ),
         )
     except HandoffError:
         raise
@@ -313,10 +327,10 @@ class PrefillWorker:
         kv_cache_dtype: str = "native",
         name: str = "prefill0",
     ):
-        if kv_cache_dtype not in ("native", "int8"):
+        if kv_cache_dtype not in ("native", "int8", "int4"):
             raise ValueError(
-                f"kv_cache_dtype={kv_cache_dtype!r}: expected 'native' "
-                "or 'int8'"
+                f"kv_cache_dtype={kv_cache_dtype!r}: expected 'native', "
+                "'int8' or 'int4'"
             )
         if prefill_chunk is not None and (
             prefill_chunk < page_size or prefill_chunk % page_size
@@ -329,7 +343,8 @@ class PrefillWorker:
         self.variables = variables
         self.name = name
         self.page_size = page_size
-        self.quantized = kv_cache_dtype == "int8"
+        self.kv_cache_dtype = kv_cache_dtype
+        self.quantized = kv_cache_dtype != "native"
         self._chunk = prefill_chunk
         g = lm.graph
         self._embed = g.node("embed").module
@@ -343,11 +358,17 @@ class PrefillWorker:
         self._pager = Pager(pool_pages, slots, pps)
         heads, hd = self._heads, self._head_dim
 
+        if kv_cache_dtype == "int4" and hd % 2:
+            raise ValueError(
+                f"kv_cache_dtype='int4' needs an even head_dim, got {hd}"
+            )
+        vw = hd // 2 if kv_cache_dtype == "int4" else hd
+
         def one_pool():
             if self.quantized:
                 return (
                     jnp.zeros(
-                        (pool_pages, heads, page_size, hd), jnp.int8
+                        (pool_pages, heads, page_size, vw), jnp.int8
                     ),
                     jnp.zeros(
                         (pool_pages, heads, page_size, 1), jnp.float32
@@ -534,6 +555,7 @@ class PrefillWorker:
             n_pages=m,
             quantized=self.quantized,
             blocks=blocks,
+            kv_dtype=self.kv_cache_dtype,
         )
 
     def step(self) -> list[KVHandoff]:
@@ -619,11 +641,11 @@ class DisaggServer:
                 f"prefill page size {prefill.page_size} != decode page "
                 f"size {decode._page}"
             )
-        if prefill.quantized != decode._kv_quant:
+        if prefill.kv_cache_dtype != decode._kv_dtype:
             raise ValueError(
                 "prefill/decode kv_cache_dtype mismatch "
-                f"(prefill int8={prefill.quantized}, decode "
-                f"int8={decode._kv_quant})"
+                f"(prefill {prefill.kv_cache_dtype!r}, decode "
+                f"{decode._kv_dtype!r})"
             )
         if prefill.lm.vocab != decode.lm.vocab:
             raise ValueError("prefill/decode vocab mismatch")
@@ -870,7 +892,7 @@ class DisaggServer:
                 landed.prompt,
                 landed.blocks,
                 landed.page_size,
-                landed.quantized,
+                landed.kv_dtype,
             )
         except (HandoffError, ValueError) as e:
             self._fail(sid, e)

@@ -510,6 +510,24 @@ def engine_collector(reg: MetricsRegistry) -> None:
     # retired batcher's engine.flops.*/mbu/mfu entries disappear with
     # its memory gauges instead of scraping stale forever.
     totals.update(_roofline_gauges())
+    # Kernel-vs-oracle dispatch gauges (ops/decode_attention records
+    # every dispatcher resolution at trace time): the
+    # ``_kernel_supported`` fallback to the XLA oracle used to be
+    # SILENT — a perf cliff invisible in metrics. 1.0 = the op's most
+    # recent lowering took the Pallas kernel, 0.0 = the oracle; the
+    # per-path lifetime counts ride along so a mixed history (some
+    # programs on each path) is visible too.
+    try:
+        from adapt_tpu.ops.decode_attention import kernel_dispatch_stats
+
+        for op, d in kernel_dispatch_stats().items():
+            totals[f"engine.kernel_dispatch.{op}"] = d["last"]
+            totals[f"engine.kernel_dispatch.{op}.pallas_total"] = (
+                d["pallas"]
+            )
+            totals[f"engine.kernel_dispatch.{op}.xla_total"] = d["xla"]
+    except Exception:  # noqa: BLE001 — never break a scrape
+        pass
     for k, v in totals.items():
         reg.set_gauge(k, v)
     # Gauges whose every source retired since the last pass (a closed
@@ -532,24 +550,39 @@ global_metrics().register_collector(engine_collector)
 
 # -- roofline accounting ----------------------------------------------------
 
-#: Peak (FLOP/s, HBM bytes/s) per JAX platform — the denominators of
-#: MFU/MBU. Values mirror the benchmark constants
-#: (``benchmarks/tpu_models.py`` TPU_V5E_PEAK_FLOPS = 197e12 bf16;
-#: ``benchmarks/README.md`` decode-MBU model uses 819 GB/s for v5e
-#: HBM). Platforms absent here (CPU!) get NO mfu/mbu gauges — flops
-#: and bytes export alone, because dividing by a made-up peak would
-#: manufacture a utilization number.
+#: Peak (FLOP/s, HBM bytes/s) per device KIND (``device.device_kind``,
+#: lowercased) with a bare-platform fallback row — the denominators of
+#: MFU/MBU. Generation rows are the published bf16 peak FLOP/s and HBM
+#: bandwidth: v4 275 TF / 1.23 TB/s, v5e 197 TF / 819 GB/s (mirroring
+#: ``benchmarks/tpu_models.py`` TPU_V5E_PEAK_FLOPS and the
+#: ``benchmarks/README.md`` decode-MBU model), v5p 459 TF / 2.77 TB/s,
+#: v6e (Trillium) 918 TF / 1.64 TB/s. The bare ``"tpu"`` row keeps the
+#: historical v5e default for kinds not listed (override via the env
+#: knobs below). Platforms absent here (CPU!) get NO mfu/mbu gauges —
+#: flops and bytes export alone, because dividing by a made-up peak
+#: would manufacture a utilization number.
 ROOFLINE_PEAKS: dict[str, tuple[float, float]] = {
     "tpu": (197e12, 8.19e11),
+    "tpu v4": (275e12, 1.2288e12),
+    "tpu v5e": (197e12, 8.19e11),
+    "tpu v5 lite": (197e12, 8.19e11),
+    "tpu v5p": (459e12, 2.765e12),
+    "tpu v5": (459e12, 2.765e12),
+    "tpu v6e": (918e12, 1.64e12),
+    "tpu v6 lite": (918e12, 1.64e12),
 }
 
 
 def roofline_peaks() -> tuple[float, float] | None:
     """(peak FLOP/s, peak bytes/s) for the current backend, or None
-    when no honest peak is known. ``ADAPT_TPU_PEAK_FLOPS`` /
-    ``ADAPT_TPU_PEAK_BYTES_S`` env vars override both (set BOTH) — the
-    knob for other TPU generations, and what lets tests exercise the
-    mfu/mbu math on the CPU backend with explicit, visible peaks."""
+    when no honest peak is known. Resolution order: the
+    ``ADAPT_TPU_PEAK_FLOPS`` / ``ADAPT_TPU_PEAK_BYTES_S`` env vars
+    override everything (set BOTH — the knob for unlisted hardware,
+    and what lets tests exercise the mfu/mbu math on the CPU backend
+    with explicit, visible peaks); otherwise the device KIND row
+    (``jax.local_devices()[0].device_kind``, lowercased — v4/v5e/v5p/
+    v6e each have their own peaks), falling back to the bare platform
+    row. Catalog: ``docs/OBSERVABILITY.md`` "Roofline gauges"."""
     env_f = os.environ.get("ADAPT_TPU_PEAK_FLOPS")
     env_b = os.environ.get("ADAPT_TPU_PEAK_BYTES_S")
     if env_f and env_b:
@@ -560,9 +593,13 @@ def roofline_peaks() -> tuple[float, float] | None:
     try:
         import jax
 
-        platform = jax.local_devices()[0].platform
+        dev = jax.local_devices()[0]
+        platform = dev.platform
+        kind = str(getattr(dev, "device_kind", "") or "").lower()
     except Exception:  # noqa: BLE001 — no backend: no claims
         return None
+    if kind in ROOFLINE_PEAKS:
+        return ROOFLINE_PEAKS[kind]
     return ROOFLINE_PEAKS.get(platform)
 
 

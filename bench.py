@@ -160,45 +160,123 @@ def main() -> int:
     stem = str_flag(sys.argv, "--stem", "conv7", choices=("conv7", "s2d"))
     notes: list[str] = []
     attempts = ATTEMPTS
-    # Fast relay probe: with the relay DOWN, backend init HANGS, so each
-    # TPU attempt would burn its full child timeout — three of them plus
-    # backoffs is ~40 min, past some driver timeouts (r03's BENCH was
-    # rc=124 exactly this way). One cheap probe (own subprocess, own
-    # hard timeout) detects the wedge up front, keeping the
-    # healthy-relay schedule (and its numbers) untouched.
-    # Only a probe HANG degrades the schedule: a fast-failing relay
-    # (rc!=0 in seconds) costs the retry loop almost nothing and is
-    # exactly the transient mode the backoff retries exist to ride out.
-    # Probe output goes to a real file, not pipes — after a timeout,
+    # Fast relay probe, PHASED (r06 rebuild): with the relay DOWN,
+    # backend init HANGS, so each TPU attempt would burn its full child
+    # timeout — three of them plus backoffs is ~40 min, past some
+    # driver timeouts (r03's BENCH was rc=124 exactly this way). The
+    # r05 Popen/killpg rebuild stopped the lingering process group but
+    # the probe itself still HUNG with no evidence of WHERE, so every
+    # blind round since r03 has been un-diagnosable from the artifact.
+    # The probe now runs two phases and prints one JSON line per phase
+    # to a real file as it completes:
+    #   1. "devices"    — import jax + enumerate devices (the r03-r05
+    #                     hang site: PJRT init through the tunnel);
+    #   2. "warm_touch" — a CHEAP first-op device touch (jit add +
+    #                     block_until_ready) with its OWN short
+    #                     in-child alarm, run only when a TPU is
+    #                     present — so the tiny-first measurement
+    #                     schedule starts against a warmed runtime and
+    #                     a first-op wedge is attributed to THIS phase
+    #                     instead of timing out a full 600 s attempt.
+    # The TRANSCRIPT (every phase line that landed) is stamped into the
+    # BENCH report EITHER WAY — a hang now names its phase, and a
+    # completed probe on a TPU-less host proves "hardware absent", the
+    # only legitimate tpu_blind cause. In-child alarms are best-effort
+    # (a C-level PJRT hang ignores SIGALRM); the parent's
+    # process-group SIGKILL remains the hard guard.
+    # Probe output goes to real files, not pipes — after a timeout,
     # draining inherited pipe fds to EOF would block (the documented
     # subprocess gotcha), turning the guard itself into a hang.
-    # Popen + killpg, not subprocess.run: the probe child is a session
-    # leader (start_new_session), and a wedged PJRT runtime keeps
-    # helper processes/threads alive that survive a plain kill() of the
-    # direct child — r05's run still stalled AFTER the probe "timed
-    # out" because the group lingered holding the tunnel. SIGKILL the
-    # whole group, then reap with a BOUNDED wait so an unkillable child
-    # cannot turn the guard into the hang it guards against.
     import tempfile
 
+    probe_src = r"""
+import json, signal, sys, time
+t0 = time.time()
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+def phase(name, timeout_s, fn):
+    def onalrm(sig, frm):
+        raise TimeoutError(name)
+    old = signal.signal(signal.SIGALRM, onalrm)
+    signal.alarm(timeout_s)
+    try:
+        out = fn() or {}
+        emit(phase=name, status="ok",
+             elapsed_s=round(time.time() - t0, 2), **out)
+        return True, out
+    except TimeoutError:
+        emit(phase=name, status="timeout", timeout_s=timeout_s,
+             elapsed_s=round(time.time() - t0, 2))
+        return False, {}
+    except Exception as e:
+        emit(phase=name, status="error", error=repr(e)[-300:],
+             elapsed_s=round(time.time() - t0, 2))
+        return False, {}
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+def devices():
+    import jax
+    devs = jax.devices()
+    return {"platform": devs[0].platform, "device_count": len(devs),
+            "kinds": sorted({str(getattr(d, "device_kind", "?"))
+                             for d in devs})}
+ok, info = phase("devices", 90, devices)
+if ok and info.get("platform") == "tpu":
+    def touch():
+        import jax
+        import jax.numpy as jnp
+        jax.jit(lambda a: a + 1)(
+            jnp.zeros((8, 128), jnp.float32)
+        ).block_until_ready()
+    phase("warm_touch", 45, touch)
+else:
+    emit(phase="warm_touch", status="skipped",
+         reason=("no TPU devices" if ok else "device phase failed"))
+emit(phase="done", status="ok", elapsed_s=round(time.time() - t0, 2))
+"""
+
     probe_hung = False  # any non-timeout failure = not hung (ADVICE r4)
-    #: Machine-readable probe outcome for the BENCH report: the r03-r05
-    #: trajectory was TPU-blind with only prose notes saying why. Any
-    #: probe hang OR failure stamps ``tpu_blind: true`` plus this
-    #: status/stderr record on whatever JSON line the run emits, so a
-    #: blind round is greppable from the artifact alone.
+    #: Machine-readable probe outcome for the BENCH report: status +
+    #: the full phase transcript stamp on EVERY emitted record (the
+    #: r03-r05 trajectory was TPU-blind with only prose notes saying
+    #: why), so a blind round is diagnosable from the artifact alone.
     probe_status: str | int = "ok"
     probe_stderr_tail = ""
-    with tempfile.TemporaryFile() as probe_err:
+    probe_transcript: list = []
+    tpu_present = False
+    #: True only when the devices phase COMPLETED (status "ok"): the
+    #: hardware_absent conclusion is allowed only then — a devices
+    #: phase that timed out or errored is a wedge/failure, which must
+    #: never be classified as hardware absence (the acceptance rule:
+    #: tpu_blind 'hardware_absent' only on a completed probe).
+    devices_ok = False
+    with tempfile.TemporaryFile() as probe_err, \
+            tempfile.TemporaryFile() as probe_out:
         probe = None
+
+        def _drain_transcript():
+            probe_out.seek(0)
+            lines = []
+            for raw in probe_out.read().decode(errors="replace").splitlines():
+                raw = raw.strip()
+                if not raw.startswith("{"):
+                    continue
+                try:
+                    lines.append(json.loads(raw))
+                except json.JSONDecodeError:
+                    continue
+            return lines
+
         try:
             probe = subprocess.Popen(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                stdout=subprocess.DEVNULL,
+                [sys.executable, "-c", probe_src],
+                stdout=probe_out,
                 stderr=probe_err,
                 start_new_session=True,
             )
-            rc = probe.wait(timeout=120)
+            rc = probe.wait(timeout=150)
+            probe_transcript = _drain_transcript()
             if rc != 0:
                 probe_status = rc
                 probe_err.seek(0)
@@ -206,6 +284,11 @@ def main() -> int:
                     probe_err.read()[-200:].decode(errors="replace").strip()
                 )
                 notes.append(f"relay probe rc={rc}: {probe_stderr_tail}")
+            elif not any(
+                p.get("phase") == "done" for p in probe_transcript
+            ):
+                probe_status = "incomplete"
+                notes.append("relay probe exited 0 without a done phase")
         except subprocess.TimeoutExpired:
             probe_hung = True
             probe_status = "hung"
@@ -218,6 +301,10 @@ def main() -> int:
             except subprocess.TimeoutExpired:
                 probe_status = "unkillable"
                 notes.append("relay probe unkillable (survived SIGKILL)")
+            probe_transcript = _drain_transcript()
+            if probe_transcript:
+                last = probe_transcript[-1].get("phase", "?")
+                notes.append(f"probe hung after phase {last!r}")
             probe_err.seek(0)
             probe_stderr_tail = (
                 probe_err.read()[-200:].decode(errors="replace").strip()
@@ -228,6 +315,13 @@ def main() -> int:
             notes.append(f"relay probe error: {exc!r}")
             if probe is not None and probe.poll() is None:
                 probe.kill()
+            # Whatever phase lines landed before the failure are still
+            # evidence — never drop them.
+            probe_transcript = _drain_transcript()
+        for p in probe_transcript:
+            if p.get("phase") == "devices" and p.get("status") == "ok":
+                devices_ok = True
+                tpu_present = p.get("platform") == "tpu"
 
     cache_warm = os.path.isdir(CACHE_DIR) and bool(os.listdir(CACHE_DIR))
 
@@ -308,27 +402,70 @@ def main() -> int:
         # record that did not measure on the TPU is blind — the common
         # case is a healthy probe followed by TPU attempts timing out
         # into the CPU fallback, not just a failed probe. The probe's
-        # own evidence rides along whenever it had any.
+        # full phase transcript rides on EVERY record (r06): a blind
+        # round must be diagnosable — hardware absence (probe completed,
+        # no TPU devices) vs a probe hang (transcript names the phase)
+        # — from the artifact alone.
         blind = record.get("platform") != "tpu"
-        if blind or probe_status != "ok":
-            record["tpu_blind"] = blind
-        if probe_status != "ok":
-            record["tpu_probe"] = {
-                "status": probe_status,
-                "stderr_tail": probe_stderr_tail,
-            }
+        if blind:
+            record["tpu_blind"] = True
+            if probe_status == "ok" and devices_ok and not tpu_present:
+                # Only a COMPLETED devices phase may conclude absence —
+                # an in-child timeout on that phase is a wedge, even
+                # when the probe process exits cleanly around it.
+                record["tpu_blind_cause"] = "hardware_absent"
+            elif probe_status in ("hung", "unkillable") or (
+                probe_status in ("ok", "incomplete") and not devices_ok
+            ):
+                record["tpu_blind_cause"] = "probe_hang"
+            else:
+                record["tpu_blind_cause"] = "tpu_attempts_failed"
+        record["tpu_probe"] = {
+            "status": probe_status,
+            "tpu_present": tpu_present,
+            "transcript": probe_transcript,
+        }
+        if probe_stderr_tail:
+            record["tpu_probe"]["stderr_tail"] = probe_stderr_tail
         print(json.dumps(record), flush=True)
         return 0
 
     if probe_hung:
         # WEDGED runtime, not a merely-slow one: the probe could not even
-        # enumerate devices in 120 s, so every TPU attempt would burn its
+        # finish its phases in 150 s, so every TPU attempt would burn its
         # full child timeout the same way (r05 postmortem: the
         # tiny-first TPU escalation this branch used to run spent
         # another 300 s timing out before the CPU row landed). Degrade
         # STRAIGHT to the CPU evidence-of-life number — flagged
         # "platform": "cpu" with the hang in "note", loud not silent.
-        notes.append("relay probe HUNG (120s); degrading to CPU")
+        notes.append("relay probe HUNG (150s); degrading to CPU")
+        record = _attempt("cpu", 3, 2, 600)
+        if record is not None:
+            return _emit(record)
+    elif probe_status == "ok" and devices_ok and not tpu_present:
+        # Probe COMPLETED and enumerated a TPU-less backend: hardware
+        # absence, the one legitimate tpu_blind cause. Burning
+        # 600+420+420 s of TPU attempts against a backend the probe
+        # just proved has no TPU devices would reproduce the r03-r05
+        # blind-with-no-evidence pattern; go straight to the flagged
+        # CPU row with the completed transcript as proof.
+        notes.append(
+            "relay probe completed: no TPU devices (hardware absent); "
+            "skipping TPU attempts"
+        )
+        record = _attempt("cpu", 3, 2, 600)
+        if record is not None:
+            return _emit(record)
+    elif probe_status in ("ok", "incomplete") and not devices_ok:
+        # The probe PROCESS exited but its devices phase never
+        # completed (the in-child alarm fired at a Python-interruptible
+        # point of a wedged init): a wedge wearing a clean exit. Every
+        # TPU attempt would burn its full child timeout the same way —
+        # degrade straight to the CPU row, transcript naming the phase.
+        notes.append(
+            "relay probe devices phase did not complete "
+            "(wedged init); degrading to CPU"
+        )
         record = _attempt("cpu", 3, 2, 600)
         if record is not None:
             return _emit(record)
@@ -350,14 +487,16 @@ def main() -> int:
         "vs_baseline": 0.0,
         "error": "; ".join(notes)[-1000:],
         # No measurement landed at all — the round is TPU-blind by
-        # definition; include the probe evidence when it was the probe.
+        # definition; the probe transcript rides along either way.
         "tpu_blind": True,
-    }
-    if probe_status != "ok":
-        record["tpu_probe"] = {
+        "tpu_probe": {
             "status": probe_status,
-            "stderr_tail": probe_stderr_tail,
-        }
+            "tpu_present": tpu_present,
+            "transcript": probe_transcript,
+        },
+    }
+    if probe_stderr_tail:
+        record["tpu_probe"]["stderr_tail"] = probe_stderr_tail
     print(json.dumps(record), flush=True)
     return 0
 

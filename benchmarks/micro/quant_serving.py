@@ -7,8 +7,11 @@ batcher's caches — dense slot strips AND paged pools — become
 ``(hd + 4) / (hd * native_itemsize)`` of the native layout (0.3125 at
 f32/hd=16) whatever the traffic, and the counter-based hot-path
 contracts must survive the composition. This driver runs the full
-dense/paged x native/int8 x plain/spec grid (one small model, identical
-traffic) and reports per config:
+dense/paged x native/int8/int4 x plain/spec grid (one small model,
+identical traffic; int4 packs two nibbles per int8 lane for
+``(hd/2 + 4) / (hd * 4)`` = 0.1875 at f32/hd=16, gated as a second
+record ``micro_quant_int4_kv_bytes_ratio`` <= 0.2) and reports per
+config:
 
 - ``<cfg>_kv_bytes`` — ``stats()["cache_bytes"]`` (scale planes
   INCLUDED — the honest number the memory.kv_bytes gauges serve);
@@ -92,7 +95,7 @@ def main() -> int:
         extras: dict = {}
         kv_bytes: dict[tuple, int] = {}
         for layout in ("slots", "paged"):
-            for dtype in ("native", "int8"):
+            for dtype in ("native", "int8", "int4"):
                 for spec in (False, True):
                     tag = (
                         f"{'paged' if layout == 'paged' else 'dense'}"
@@ -132,22 +135,36 @@ def main() -> int:
                         )
                     bat.close()
         ratios = []
+        ratios4 = []
         for layout in ("slots", "paged"):
             for spec in (False, True):
                 n = kv_bytes[(layout, "native", spec)]
                 q = kv_bytes[(layout, "int8", spec)]
+                q4 = kv_bytes[(layout, "int4", spec)]
                 ratios.append(q / n)
+                ratios4.append(q4 / n)
                 if q >= n:
                     errors.append(
                         f"{layout}{'_spec' if spec else ''}: int8 cache "
                         f"{q} not smaller than native {n}"
                     )
+                if q4 >= q:
+                    errors.append(
+                        f"{layout}{'_spec' if spec else ''}: int4 cache "
+                        f"{q4} not smaller than int8 {q}"
+                    )
         ratio = max(ratios)
+        ratio4 = max(ratios4)
         extras["kv_bytes_ratio_min"] = round(min(ratios), 4)
+        extras["int4_kv_bytes_ratio_min"] = round(min(ratios4), 4)
         if errors:
             emit(
                 "micro_quant_kv_bytes_ratio", 1.0, "x", 0.0,
                 error="; ".join(errors)[-300:], **extras,
+            )
+            emit(
+                "micro_quant_int4_kv_bytes_ratio", 1.0, "x", 0.0,
+                error="; ".join(errors)[-300:],
             )
             return 0
         emit(
@@ -158,6 +175,17 @@ def main() -> int:
             ticks=n_ticks,
             slots=slots,
             **extras,
+        )
+        # Second gated record: the int4 grid's worst per-slot KV bytes
+        # ratio vs native (analytic (hd/2 + 4) / (hd * 4) = 0.1875 at
+        # f32/hd=16; the ISSUE-12 capacity pin is <= 0.2).
+        emit(
+            "micro_quant_int4_kv_bytes_ratio",
+            round(ratio4, 4),
+            "x",
+            round(0.2 - ratio4, 4),
+            ticks=n_ticks,
+            slots=slots,
         )
     except Exception as e:  # noqa: BLE001 — always one JSON line, rc 0
         emit("micro_quant_kv_bytes_ratio", 1.0, "x", 0.0,
