@@ -351,6 +351,116 @@ class DisaggConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class CacheTierConfig:
+    """Hierarchical KV cache: a host-DRAM (optionally disk-backed)
+    spill tier UNDER the paged prefix cache (``runtime/paged.HostKVTier``
+    + ``runtime/continuous``; ``docs/SERVING.md`` §3).
+
+    The HBM prefix LRU caps how many cold prefixes stay warm; without a
+    tier, an evicted rc=0 page simply dies and the next same-prefix
+    admission recomputes it. With a tier, evicted pages SPILL to host
+    buffers (tracked by the same content keys), and the admission
+    probe consults the host tier before declaring a prefix miss — a
+    host hit re-enters the pool through the existing
+    ``Pager.adopt_cached`` / ``_adopt_pages`` landing path (the
+    disaggregated-handoff machinery: epoch-carrying, tp-sharded
+    placement via ``KVHandoffPlan`` per-shard slices — never a
+    gather) and then admits as an ordinary prefix-cache hit.
+
+    Two host sub-tiers, each with its own codec
+    (``ops.quantize.encode_page``): WARM pages keep a LOSSLESS codec
+    (bit-exact readmits — the default end to end), COLD pages (demoted
+    past ``warm_capacity_pages``) may take a LOSSY codec (blockwise
+    int8/int4-with-scales or zfp-style mantissa truncation — the
+    paper's lz4+zfp transfer-compression DNA). Lossy codecs only ever
+    touch SPILLED pages, which are rc=0 by construction — a page
+    referenced by a live slot is never spilled, so live decode state
+    is never degraded. Spill and readmit work are budgeted PER TICK so
+    the decode loop never stalls behind tier traffic."""
+
+    #: Total host-tier capacity in pages (warm + cold, memory-resident).
+    host_capacity_pages: int = 1024
+    #: Pages held in the WARM sub-tier before demotion to COLD.
+    warm_capacity_pages: int = 256
+    #: WARM codec — must be lossless ("raw" | "lz"): a warm readmit is
+    #: bit-exact by construction.
+    warm_codec: str = "lz"
+    #: COLD codec — "raw" | "lz" (lossless) or "int8" | "int4" | "zfp"
+    #: (lossy; applied to FLOAT page planes only — int value planes of
+    #: quantized pools fall back to lossless packing). Default
+    #: lossless, so the whole hierarchy is bit-exact unless lossy
+    #: compression is opted into.
+    cold_codec: str = "lz"
+    #: Max pages spilled (D2H fetch + encode) per decode tick — bounds
+    #: the tier work any single tick pays. Evictions past the budget
+    #: drop their content (``cache_tier.dropped_total``).
+    spill_pages_per_tick: int = 8
+    #: Max pages readmitted (decode + H2D landing) per decode tick;
+    #: prompts whose host hits exceed it recompute the tail instead of
+    #: stalling admission.
+    readmit_pages_per_tick: int = 8
+    #: Proactive spill watermarks, as fractions of the allocatable
+    #: pool: when the HBM prefix LRU holds >= ``spill_watermark`` of
+    #: the pool, the tier pre-spills the coldest un-backed LRU pages
+    #: (budgeted) until the un-backed cold set is down to
+    #: ``spill_low_watermark`` — so demand evictions under admission
+    #: pressure find their content already host-backed (a free evict)
+    #: instead of paying a fetch inside the admission path.
+    spill_watermark: float = 0.5
+    spill_low_watermark: float = 0.25
+    #: Optional disk directory: COLD pages demoted past the host
+    #: capacity persist as files there instead of dropping.
+    disk_dir: str | None = None
+    #: Codec for the disaggregated MSG_KV_PAGES wire
+    #: (``runtime/disagg.pack_handoff``): "raw" (today's zero-copy
+    #: frames) or any page codec — the crc check runs on the
+    #: compressed payload either way. ``DisaggServer`` reads it off
+    #: the decode batcher's tier config unless given explicitly.
+    wire_codec: str = "raw"
+
+    def __post_init__(self):
+        # Direct symbol imports: the ops package re-exports a FUNCTION
+        # named ``quantize`` that shadows the module on any
+        # ``import ... as`` attribute lookup.
+        from adapt_tpu.ops.quantize import (
+            LOSSLESS_PAGE_CODECS,
+            PAGE_CODECS,
+        )
+
+        if self.host_capacity_pages < 1:
+            raise ValueError(
+                f"host_capacity_pages must be >= 1, got "
+                f"{self.host_capacity_pages}"
+            )
+        if not 0 <= self.warm_capacity_pages <= self.host_capacity_pages:
+            raise ValueError(
+                f"warm_capacity_pages must be in [0, "
+                f"host_capacity_pages], got {self.warm_capacity_pages}"
+            )
+        if self.warm_codec not in LOSSLESS_PAGE_CODECS:
+            raise ValueError(
+                f"warm_codec={self.warm_codec!r}: the warm tier must "
+                f"be lossless ({LOSSLESS_PAGE_CODECS})"
+            )
+        for name in ("cold_codec", "wire_codec"):
+            v = getattr(self, name)
+            if v not in PAGE_CODECS:
+                raise ValueError(
+                    f"{name}={v!r}: expected one of {PAGE_CODECS}"
+                )
+        for name in ("spill_pages_per_tick", "readmit_pages_per_tick"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0")
+        if not (
+            0.0 <= self.spill_low_watermark <= self.spill_watermark <= 1.0
+        ):
+            raise ValueError(
+                "need 0 <= spill_low_watermark <= spill_watermark <= 1, "
+                f"got {self.spill_low_watermark} / {self.spill_watermark}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class TenantQuota:
     """Per-tenant traffic-control knobs (``config.SchedulerConfig``;
     ``runtime/scheduler.AdmissionQueue``). ``weight`` is the tenant's
@@ -605,3 +715,7 @@ class ServeConfig:
     scheduler: SchedulerConfig = dataclasses.field(
         default_factory=SchedulerConfig
     )
+    #: Hierarchical KV cache tier (None = off: evicted prefix pages
+    #: die, today's behavior). Opt-in, unlike the sibling subsystem
+    #: configs — a host tier changes where evicted bytes live.
+    cache_tier: CacheTierConfig | None = None

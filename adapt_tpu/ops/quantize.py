@@ -257,6 +257,172 @@ def dequantize_params(tree):
     )
 
 
+# -- page codec stack (hierarchical KV cache tiers, runtime/paged) -----------
+#
+# Host-side codecs for KV PAGES crossing a memory-hierarchy boundary:
+# spills to the host-DRAM tier (``runtime/paged.HostKVTier``), readmits
+# back into the pool, and the disaggregated MSG_KV_PAGES wire
+# (``runtime/disagg.pack_handoff``) — the TPU-era re-expression of the
+# reference's per-transfer lz4+zfp stack at page granularity. These run
+# on numpy by construction: every call site already holds host bytes
+# (a spilled page, a wire frame), so a device kernel would only add a
+# round trip. The kernels' half of this DNA is the fused int8/int4
+# dequant in ``ops/paged_attention`` — pages readmitted from a lossy
+# tier flow straight back through it.
+#
+# Codec contract: ``decode_page(encode_page(x, c)) `` returns x's exact
+# shape and dtype; "raw"/"lz" are BIT-EXACT (the WARM-tier / lossless
+# wire setting), "int8"/"int4" are the repo's per-vector absmax
+# schemes (one f32 scale per trailing-axis vector — the same lattice
+# the quantized pools use), "zfp" is zfp-style mantissa truncation
+# (keep sign/exponent/top mantissa bits, then lz the zero-heavy tail).
+# Lossy codecs apply to FLOAT arrays only; on integer arrays (int8
+# value planes of quantized pools, prompt ids on the wire) they
+# degrade to "lz" — bit-exact — so a lossy tier can never corrupt
+# already-quantized payloads.
+
+PAGE_CODECS = ("raw", "lz", "int8", "int4", "zfp")
+LOSSLESS_PAGE_CODECS = ("raw", "lz")
+#: zfp-style truncation: mantissa bits KEPT (of f32's 23). 10 bits
+#: bounds relative error at ~2^-11 per element — comfortably inside
+#: the int8 per-vector scheme's error, and the truncated tail is what
+#: makes the trailing lz pass actually save bytes.
+ZFP_KEEP_BITS = 10
+
+
+def _np():
+    import numpy as np
+
+    return np
+
+
+def _np_pack_int4(q):
+    """numpy twin of :func:`pack_int4` (same nibble layout)."""
+    np = _np()
+    q = q.astype(np.int32)
+    lo, hi = q[..., 0::2] & 15, q[..., 1::2] & 15
+    p = lo | (hi << 4)
+    return np.where(p >= 128, p - 256, p).astype(np.int8)
+
+
+def _np_unpack_int4(packed):
+    """numpy twin of :func:`unpack_int4`."""
+    np = _np()
+    p = packed.astype(np.int32)
+    lo = ((p & 15) ^ 8) - 8
+    hi = p >> 4
+    return np.stack([lo, hi], axis=-1).reshape(
+        p.shape[:-1] + (p.shape[-1] * 2,)
+    )
+
+
+def encode_page(arr, codec: str) -> tuple[bytes, dict]:
+    """Encode one host array for a tier boundary. Returns
+    ``(payload, meta)``; ``meta`` carries everything
+    :func:`decode_page` needs (shape, dtype, the codec actually
+    applied — lossy requests on integer arrays record the "lz" they
+    degraded to) plus ``raw_nbytes`` for compression accounting."""
+    import zlib
+
+    np = _np()
+    if codec not in PAGE_CODECS:
+        raise ValueError(
+            f"codec={codec!r}: expected one of {PAGE_CODECS}"
+        )
+    arr = np.ascontiguousarray(arr)
+    meta = {
+        "shape": tuple(int(s) for s in arr.shape),
+        "dtype": str(arr.dtype),
+        "codec": codec,
+        "raw_nbytes": int(arr.nbytes),
+    }
+    lossy = codec in ("int8", "int4", "zfp")
+    if lossy and (
+        not np.issubdtype(arr.dtype, np.floating)
+        or (codec in ("int8", "int4") and arr.shape[-1] < 2)
+    ):
+        # Lossy on non-float degrades to lossless packing — a lossy
+        # tier must never perturb already-quantized int payloads. The
+        # per-vector absmax codecs also degrade on (..., 1) arrays
+        # (quantized pools' SCALE planes): one f32 scale per single
+        # element saves nothing and perturbs every later dequant.
+        codec = "lz"
+        meta["codec"] = "lz"
+    if codec == "raw":
+        return arr.tobytes(), meta
+    if codec == "lz":
+        return zlib.compress(arr.tobytes(), 1), meta
+    if codec == "zfp":
+        u = arr.astype(np.float32).view(np.uint32)
+        mask = np.uint32(
+            (0xFFFFFFFF << (23 - ZFP_KEEP_BITS)) & 0xFFFFFFFF
+        )
+        trunc = (u & mask).tobytes()
+        return zlib.compress(trunc, 1), meta
+    # int8 / int4: per-vector absmax over the trailing axis — the KV
+    # quantization scheme (quantize_kv_vectors) on host numpy.
+    qmax = 127.0 if codec == "int8" else 7.0
+    f = arr.astype(np.float32)
+    scale = np.maximum(
+        np.abs(f).max(axis=-1, keepdims=True) / qmax, 1e-8
+    ).astype(np.float32)
+    q = np.clip(np.round(f / scale), -qmax, qmax)
+    if codec == "int4":
+        if arr.shape[-1] % 2:
+            raise ValueError(
+                f"int4 page codec needs an even trailing axis, got "
+                f"{arr.shape[-1]}"
+            )
+        vals = _np_pack_int4(q)
+    else:
+        vals = q.astype(np.int8)
+    return scale.tobytes() + vals.tobytes(), meta
+
+
+def decode_page(payload, meta: dict):
+    """Inverse of :func:`encode_page`: payload (bytes-like) + meta ->
+    array of the original shape/dtype. Bit-exact for raw/lz; the lossy
+    codecs return the dequantized/truncated values cast back."""
+    import zlib
+
+    np = _np()
+    shape = tuple(meta["shape"])
+    dtype = np.dtype(meta["dtype"])
+    codec = meta["codec"]
+    buf = bytes(payload)
+    if codec == "raw":
+        return np.frombuffer(buf, dtype).reshape(shape).copy()
+    if codec == "lz":
+        return (
+            np.frombuffer(zlib.decompress(buf), dtype).reshape(shape).copy()
+        )
+    if codec == "zfp":
+        u = np.frombuffer(zlib.decompress(buf), np.uint32).reshape(shape)
+        return u.view(np.float32).astype(dtype)
+    n_vec = 1
+    for s in shape[:-1]:
+        n_vec *= s
+    scale = np.frombuffer(buf[: n_vec * 4], np.float32).reshape(
+        shape[:-1] + (1,)
+    )
+    if codec == "int4":
+        vals = np.frombuffer(buf[n_vec * 4:], np.int8).reshape(
+            shape[:-1] + (shape[-1] // 2,)
+        )
+        q = _np_unpack_int4(vals)
+    else:
+        q = np.frombuffer(buf[n_vec * 4:], np.int8).reshape(shape)
+    return (q.astype(np.float32) * scale).astype(dtype)
+
+
+def page_codec_roundtrip(arr, codec: str):
+    """``decode(encode(arr))`` — the one-call roundtrip tests and the
+    kv_tiers micro driver pin bit-exactness (lossless) or error
+    bounds (lossy) against."""
+    payload, meta = encode_page(arr, codec)
+    return decode_page(payload, meta)
+
+
 # -- pure-jnp oracles (unit-test ground truth) -------------------------------
 
 

@@ -390,6 +390,56 @@ class KVHandoffPlan:
         return jax.tree.map(self.place, tree)
 
 
+def fetch_head_shards(x, index: int, head_dim: int = 1):
+    """Host copy of ``x[index]`` assembled PER SHARD along the head
+    axis — the D2H counterpart of :meth:`KVHandoffPlan.place`, used by
+    the host-tier SPILL path (``runtime/continuous``): each device
+    ships only its resident heads (one single-device slice fetch per
+    shard), and the full logical head range is concatenated on the
+    HOST — never a device-side gather for GSPMD to materialize (the
+    same 2112.01075 discipline the reshard/handoff plans keep).
+
+    ``x`` is a leading-axis-indexed pool leaf ``(pages, kv_heads, P,
+    w)``; head ranges must tile the head axis exactly (any sharding a
+    ``kv_head_sharding`` pool can carry does) or this raises — a
+    layout the spill path cannot reassemble must fail by name, never
+    spill interleaved garbage."""
+    sharding = getattr(x, "sharding", None)
+    if sharding is None or len(getattr(sharding, "device_set", ())) <= 1:
+        return np.asarray(x[index])
+    h = x.shape[head_dim]
+    spans = []
+    for s in x.addressable_shards:
+        lo, hi = s.index[head_dim].indices(h)[:2]
+        spans.append((lo, hi, s))
+    spans.sort(key=lambda t: (t[0], t[1]))
+    pieces, cover = [], 0
+    for lo, hi, s in spans:
+        if lo < cover:
+            if hi <= cover:
+                continue  # replicated duplicate of a covered range
+            raise ValueError(
+                f"head ranges overlap without nesting: [{lo},{hi}) vs "
+                f"covered [0,{cover})"
+            )
+        if lo != cover:
+            raise ValueError(
+                f"head ranges misaligned: next shard starts at {lo}, "
+                f"covered to {cover}"
+            )
+        # One tiny slice dispatch on the shard's own device, then the
+        # per-shard D2H — the only transfers this fetch issues.
+        pieces.append(np.asarray(s.data[index]))
+        cover = hi
+    if cover != h:
+        raise ValueError(
+            f"head axis not covered: reached {cover} of {h}"
+        )
+    return (
+        pieces[0] if len(pieces) == 1 else np.concatenate(pieces, axis=0)
+    )
+
+
 def plan_kv_handoff(sharding) -> KVHandoffPlan:
     """Build the :class:`KVHandoffPlan` for a destination pool's
     sharding (None for a no-mesh pool)."""

@@ -263,6 +263,7 @@ from jax.sharding import (
 )
 
 from adapt_tpu.config import (
+    CacheTierConfig,
     KernelConfig,
     ParallelConfig,
     RecoveryConfig,
@@ -281,13 +282,18 @@ from adapt_tpu.models.transformer_lm import (
 from adapt_tpu.ops.decode_attention import check_head_parity
 from adapt_tpu.ops.quantize import dequantize_params, quantize_params
 from adapt_tpu.parallel.sharding import (
+    fetch_head_shards,
     kv_head_sharding,
     lm_tp_rules,
     plan_kv_handoff,
     plan_kv_reshard,
     tree_shardings,
 )
-from adapt_tpu.runtime.paged import Pager, insert_prefill_pages
+from adapt_tpu.runtime.paged import (
+    HostKVTier,
+    Pager,
+    insert_prefill_pages,
+)
 from adapt_tpu.runtime.scheduler import (
     AdmissionQueue,
     DegradationController,
@@ -460,6 +466,7 @@ class ContinuousBatcher:
         journal=None,
         scheduler: SchedulerConfig | None = None,
         kernel: KernelConfig | None = None,
+        cache_tier: CacheTierConfig | None = None,
     ):
         self.lm = lm
         # -- tensor parallelism (mesh-native serving) ----------------------
@@ -734,6 +741,39 @@ class ContinuousBatcher:
                     (slots, heads, self._cache_len, head_dim), block0.dtype
                 )
 
+        # -- hierarchical KV cache tier (docs/SERVING.md §3) ---------------
+        if cache_tier is not None and not self._paged:
+            raise ValueError(
+                "cache_tier requires kv_layout='paged' (the spill "
+                "tier lives under the paged prefix cache — dense slot "
+                "strips have no page unit to spill)"
+            )
+        #: Host-DRAM spill tier under the prefix LRU: evicted rc=0
+        #: pages spill (budgeted per tick) instead of dying, and the
+        #: admission probe consults the tier before declaring a prefix
+        #: miss — host hits readmit through the adopt_cached /
+        #: _adopt_pages landing path and then admit as ordinary
+        #: prefix-cache hits.
+        self._tier_cfg = cache_tier
+        self._tier = HostKVTier(cache_tier) if cache_tier else None
+        #: Per-tick tier work budgets (reset at tick entry; seeded here
+        #: so pre-first-tick evictions can spill too).
+        self._spill_budget = (
+            cache_tier.spill_pages_per_tick if cache_tier else 0
+        )
+        self._readmit_budget = (
+            cache_tier.readmit_pages_per_tick if cache_tier else 0
+        )
+        if self._tier is not None:
+            self._pager.evict_hook = self._on_page_evict
+        #: Instance-lifetime tier books (stats() mirrors of the
+        #: cache_tier.* registry counters).
+        self._tier_spilled = 0
+        self._tier_readmitted = 0
+        self._tier_dropped = 0
+        #: High-water of the tier's own overflow-drop count already
+        #: bridged to cache_tier.dropped_total (flushed per tick).
+        self._tier_drop_seen = 0
         self._caches = [(one_cache(), one_cache()) for _ in lm.block_names]
         if mesh is not None:
             # Head-sharded KV: each device holds kv_heads / tp of every
@@ -1682,6 +1722,192 @@ class ContinuousBatcher:
             self._caches, pages_dev, placed, epoch=self._mesh_epoch
         )
         return na
+
+    # -- hierarchical KV cache tier (host-DRAM spill under the Pager) ------
+
+    def _fetch_page_host(self, page: int) -> list:
+        """Host copy of one pool page's K/V across every block — the
+        spill-side D2H. Per-shard slice fetches assembled on the host
+        (``parallel.sharding.fetch_head_shards``): under tp each
+        device ships only its resident heads, mirroring the readmit
+        side's ``KVHandoffPlan`` per-shard placement — never a
+        device-side gather. Pools are functional arrays, so the fetch
+        reads the page's last-written bytes even when the allocator
+        is about to hand the page to a new owner."""
+        idx = int(page)
+        return [
+            jax.tree.map(lambda pool: fetch_head_shards(pool, idx), pair)
+            for pair in self._caches
+        ]
+
+    def _spill_page(self, page: int, key: bytes) -> bool:
+        """Capture one rc=0 page into the host tier (budget already
+        checked by the caller). Idempotent for keys the tier holds."""
+        raw, enc = self._tier.put(key, self._fetch_page_host(page))
+        if raw == 0 and enc == 0:
+            return False  # already host-resident: no new books
+        self._tier_spilled += 1
+        reg = global_metrics()
+        reg.inc("cache_tier.spilled_total")
+        reg.inc("cache_tier.codec_bytes_saved_total", float(raw - enc))
+        global_flight_recorder().record(
+            "kv_spill", page=int(page), bytes=int(enc), raw_bytes=int(raw)
+        )
+        return True
+
+    def _on_page_evict(self, page: int, key: bytes) -> None:
+        """``Pager.evict_hook``: a registered rc=0 page is leaving the
+        pool. Host-backed keys evict for free; otherwise spill inside
+        the per-tick budget, or count the content as dropped — the
+        watermark pre-spill in :meth:`_tier_step` exists to make this
+        branch rare."""
+        tier = self._tier
+        if tier is None:
+            return
+        if tier.contains(key):
+            return  # content already host-resident: eviction is free
+        if self._spill_budget <= 0:
+            self._tier_dropped += 1
+            global_metrics().inc("cache_tier.dropped_total")
+            return
+        self._spill_budget -= 1
+        self._spill_page(page, key)
+
+    def _tier_step(self) -> None:
+        """Proactive watermark spill, run once per tick BEFORE
+        admission: when the prefix LRU holds at least
+        ``spill_watermark`` of the allocatable pool, back the coldest
+        un-backed LRU pages (they evict first) down to the low
+        watermark — budget-capped, so the decode tick's tier work is
+        bounded whatever the backlog. Only rc=0 LRU pages are ever
+        scanned: live slots' pages cannot spill, so lossy cold codecs
+        can never touch state a decode still reads from HBM."""
+        cfg = self._tier_cfg
+        self._spill_budget = cfg.spill_pages_per_tick
+        self._readmit_budget = cfg.readmit_pages_per_tick
+        # Bridge the tier's own cold-overflow drops (demotions past
+        # the host capacity with no disk dir) to the registry counter.
+        over = self._tier.dropped - self._tier_drop_seen
+        if over:
+            global_metrics().inc("cache_tier.dropped_total", float(over))
+            self._tier_drop_seen = self._tier.dropped
+        alloc = self._pager.num_allocatable
+        cached = self._pager.cached_pages()
+        if len(cached) < cfg.spill_watermark * alloc:
+            return
+        # Back the coldest `need` pages: everything that would have to
+        # evict to bring the LRU down to the low watermark. (Guard the
+        # slice: a negative `need` must mean "nothing", not a slice
+        # off the wrong end of the LRU.)
+        need = len(cached) - int(cfg.spill_low_watermark * alloc)
+        if need <= 0:
+            return
+        for page, key in cached[:need]:
+            if self._spill_budget <= 0:
+                break
+            if self._tier.contains(key):
+                continue
+            self._spill_budget -= 1
+            self._spill_page(page, key)
+
+    def _maybe_readmit(self, req: "_Request") -> int:
+        """The admission probe's host-tier consult: before the prefix
+        probe declares a miss, readmit the request's longest run of
+        host-resident prefix pages back into the pool — decoded from
+        the tier, landed through the SAME ``Pager.adopt_cached`` +
+        :meth:`_adopt_pages` path as a disaggregated handoff
+        (epoch-carrying, tp-sharded per-shard placement), so the probe
+        then shares them as ordinary prefix hits. Budgeted per tick;
+        pool pressure readmits nothing (recompute is always correct).
+        Returns the number of pages readmitted."""
+        tier = self._tier
+        if tier is None or self._readmit_budget <= 0:
+            return 0
+        P = self._page
+        s0 = req.prompt.shape[0]
+        keys: list[bytes] = []
+        blocks_list: list[list] = []
+        for j in range((s0 - 1) // P):
+            key = Pager.prefix_key(req.prompt, (j + 1) * P)
+            if self._pager.resident(key):
+                continue  # probe will share it without our help
+            if len(keys) >= self._readmit_budget:
+                break
+            blocks = tier.get(key)
+            if blocks is None:
+                break  # true miss — later pages can't extend the run
+            keys.append(key)
+            blocks_list.append(blocks)
+        if not keys:
+            return 0
+        adopted = self._pager.adopt_cached(keys)
+        if not adopted:
+            return 0  # pool pressure — admission recomputes instead
+        ords = [i for i, _ in adopted]
+        pages = [p for _, p in adopted]
+        na = len(ords)
+        nb = 1
+        while nb < na:
+            nb *= 2
+
+        def stack(*leaves):
+            out = np.zeros((nb,) + leaves[0].shape, leaves[0].dtype)
+            for t, j in enumerate(ords):
+                out[t] = leaves[j]
+            return out
+
+        placed = [
+            jax.tree.map(stack, *[bl[b] for bl in blocks_list])
+            for b in range(len(self._blocks))
+        ]
+        plan = plan_kv_handoff(
+            self._kv_sharding if self._mesh is not None else self._repl
+        )
+        placed = [plan.place_tree(pair) for pair in placed]
+        self._h2d_count += sum(
+            len(jax.tree.leaves(pair)) for pair in placed
+        )
+        pages_dev = self._h2d(
+            np.asarray(pages + [0] * (nb - na), np.int32)
+        )
+        self._variants.setdefault("continuous.adopt_pages", set()).add(nb)
+        self._caches = self._adopt_pages(
+            self._caches, pages_dev, placed, epoch=self._mesh_epoch
+        )
+        self._readmit_budget -= na
+        self._tier_readmitted += na
+        reg = global_metrics()
+        reg.inc("cache_tier.readmitted_total", float(na))
+        global_flight_recorder().record(
+            "kv_readmit",
+            request=req.req_id,
+            pages=na,
+            staged_bytes=int(plan.staged_bytes),
+        )
+        return na
+
+    def prefix_cached(self, prompt) -> int:
+        """Leading FULL pages of ``prompt`` servable from the cache
+        HIERARCHY without recompute: the longest run of prefix keys
+        that are HBM-resident or (when a cache tier is configured)
+        host-spilled. Read-only — no shares taken, no readmits, no
+        probe accounting moved; the number a prefix-affinity router
+        or capacity audit wants (``benchmarks/load/tier_smoke``
+        measures the host tier's servable-prefix multiplier with
+        it)."""
+        if not self._paged:
+            return 0
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n = 0
+        for j in range((prompt.shape[0] - 1) // self._page):
+            key = Pager.prefix_key(prompt, (j + 1) * self._page)
+            if self._pager.resident(key) or (
+                self._tier is not None and self._tier.contains(key)
+            ):
+                n += 1
+            else:
+                break
+        return n
 
     def _insert_paged(self, caches, pages, kvs):
         """Scatter a prefilled request's per-block K/V into its pages
@@ -3177,6 +3403,12 @@ class ContinuousBatcher:
                 # forward is never empty (the first sampled token needs
                 # a live last-position hidden state).
                 P = self._page
+                if self._tier is not None:
+                    # Consult the host tier BEFORE the probe declares
+                    # any miss: host-resident prefix pages readmit
+                    # (budgeted) through the adopt_cached landing path
+                    # and then share below as ordinary hits.
+                    self._maybe_readmit(req)
                 for j in range((s0 - 1) // P):
                     key = Pager.prefix_key(req.prompt, (j + 1) * P)
                     if self._pager.lookup_share(i, key) is None:
@@ -3643,6 +3875,12 @@ class ContinuousBatcher:
             # Closed-loop degradation BEFORE admission: this tick's
             # admits see the ladder's current shed level.
             self._controller.step(self)
+        if self._tier is not None:
+            # Host-tier step BEFORE admission: reset the per-tick
+            # spill/readmit budgets and pre-spill the coldest LRU
+            # pages past the watermark, so admission-pressure
+            # evictions this tick find their content host-backed.
+            self._tier_step()
         eo = self._eobs
         # Snapshot the gate ONCE per tick (see _spec_decode).
         eo_on = eo.enabled
@@ -3924,6 +4162,14 @@ class ContinuousBatcher:
                 out["prefix_hits"] = ps.prefix_hits
                 out["prefix_misses"] = ps.prefix_misses
                 out["prefix_capacity_skips"] = ps.prefix_capacity_skips
+            if self._tier is not None:
+                ts = self._tier.stats()
+                out["host_pages"] = ts.pages
+                out["host_bytes"] = ts.host_bytes
+                out["tier_spilled"] = self._tier_spilled
+                out["tier_readmitted"] = self._tier_readmitted
+                out["tier_dropped"] = self._tier_dropped + ts.dropped
+                out["tier_codec_bytes_saved"] = ts.codec_bytes_saved
         return out
 
     def _memory_stats(self) -> dict[str, float]:
@@ -3977,6 +4223,15 @@ class ContinuousBatcher:
             out["paged.prefix_capacity_skips"] = float(
                 ps.prefix_capacity_skips
             )
+            if self._tier is not None:
+                # Host-tier occupancy: pages_spilled counts pages
+                # RESIDENT in host memory (warm + cold), host_bytes
+                # their post-codec footprint. The HBM partition above
+                # (used + free + cached == pool_pages) is untouched —
+                # the tier is a copy below it, never double-counted.
+                ts = self._tier.stats()
+                out["memory.host_bytes"] = float(ts.host_bytes)
+                out["memory.pages_spilled"] = float(ts.pages)
         else:
             out["memory.kv_bytes"] = cache_bytes
             out["memory.kv_bytes_per_device"] = per_device

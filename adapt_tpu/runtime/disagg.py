@@ -54,6 +54,8 @@ that split, TPU-native and single-process-testable:
   routing to a tier whose lease expired.
 
 Observability: ``disagg.{handoff_bytes,handoff_s,pages_streamed}``
+(+ ``disagg.handoff_bytes_raw`` — wire bytes are POST-codec when a
+``wire_codec`` is set, so raw/wire is the compression ratio)
 plus the ``kv_handoff`` flight event per landing;
 ``continuous.prefill_stall_s`` on the decode batcher shows what the
 handoff removed. ``docs/SERVING.md`` "Disaggregated prefill/decode"
@@ -164,40 +166,70 @@ def _leaves(handoff: KVHandoff) -> list[np.ndarray]:
     return out
 
 
-def pack_handoff(handoff: KVHandoff) -> Message:
+def handoff_raw_nbytes(handoff: KVHandoff) -> int:
+    """Uncompressed payload bytes of a handoff (every wire tensor's
+    host nbytes) — the numerator dashboards divide
+    ``disagg.handoff_bytes`` by to read the wire compression ratio."""
+    return sum(int(arr.nbytes) for arr in _leaves(handoff))
+
+
+def pack_handoff(handoff: KVHandoff, wire_codec: str = "raw") -> Message:
     """Frame a handoff for the comm tier: every tensor becomes one
     zero-copy codec frame (``codec.pack_frames`` with the raw codec —
     scatter-write parts, no payload copy; ``codec.copy_stats()`` pins
     it), concatenated in wire order as the message payload; the
     page-range annex carries the geometry and per-tensor frame
-    lengths needed to slice them back out."""
-    raw = codec.get_codec("none")
+    lengths needed to slice them back out.
+
+    ``wire_codec`` != "raw" compresses each tensor through the
+    ``ops.quantize`` page codec stack before framing (lossless "lz",
+    or lossy "int8"/"int4"/"zfp" on FLOAT tensors only — the prompt
+    and int value planes always pack lossless). The annex then
+    carries per-tensor codec meta, and the crc is computed over the
+    COMPRESSED payload — corruption is detected before any decode
+    touches the bytes, exactly like the raw path."""
     parts: list = []
     frame_lens: list[int] = []
     crc = 0
-    for arr in _leaves(handoff):
-        frames = codec.pack_frames(raw, arr)
-        frame_lens.append(codec.frames_nbytes(frames))
-        for p in frames:
-            # Payload integrity: flipped bits in a KV page would
-            # otherwise scatter SILENTLY into a live pool (raw codec
-            # frames parse fine whatever the bytes hold). One crc pass
-            # over views — no copy, ~free next to the transfer itself.
-            crc = zlib.crc32(p, crc)
-        parts.extend(frames)
-    annex = json.dumps(
-        {
-            "req_id": int(handoff.req_id),
-            "page_size": int(handoff.page_size),
-            "n_pages": int(handoff.n_pages),
-            "quantized": bool(handoff.quantized),
-            "kv_dtype": handoff.kv_dtype,
-            "blocks": len(handoff.blocks),
-            "prompt_len": int(handoff.prompt.shape[0]),
-            "frame_lens": frame_lens,
-            "crc32": crc,
-        }
-    ).encode()
+    leaf_meta: list[dict] | None = None
+    if wire_codec != "raw":
+        from adapt_tpu.ops.quantize import encode_page
+
+        leaf_meta = []
+        for arr in _leaves(handoff):
+            payload, meta = encode_page(np.asarray(arr), wire_codec)
+            frame_lens.append(len(payload))
+            leaf_meta.append(meta)
+            crc = zlib.crc32(payload, crc)
+            parts.append(memoryview(payload))
+    else:
+        raw = codec.get_codec("none")
+        for arr in _leaves(handoff):
+            frames = codec.pack_frames(raw, arr)
+            frame_lens.append(codec.frames_nbytes(frames))
+            for p in frames:
+                # Payload integrity: flipped bits in a KV page would
+                # otherwise scatter SILENTLY into a live pool (raw codec
+                # frames parse fine whatever the bytes hold). One crc
+                # pass over views — no copy, ~free next to the transfer
+                # itself.
+                crc = zlib.crc32(p, crc)
+            parts.extend(frames)
+    meta = {
+        "req_id": int(handoff.req_id),
+        "page_size": int(handoff.page_size),
+        "n_pages": int(handoff.n_pages),
+        "quantized": bool(handoff.quantized),
+        "kv_dtype": handoff.kv_dtype,
+        "blocks": len(handoff.blocks),
+        "prompt_len": int(handoff.prompt.shape[0]),
+        "frame_lens": frame_lens,
+        "crc32": crc,
+    }
+    if leaf_meta is not None:
+        meta["wire_codec"] = wire_codec
+        meta["leaf_meta"] = leaf_meta
+    annex = json.dumps(meta).encode()
     return Message(
         msg_type=MSG_KV_PAGES,
         stage_index=0,
@@ -223,13 +255,38 @@ def unpack_handoff(msg: Message) -> KVHandoff:
         meta = json.loads(msg.page_annex.decode())
         n_blocks = int(meta["blocks"])
         quantized = bool(meta["quantized"])
+        # The crc always runs on the WIRE payload — post-codec bytes
+        # when wire compression is on — so corruption is caught before
+        # any codec decode touches the buffer.
         got_crc = zlib.crc32(msg.payload)
         if got_crc != int(meta["crc32"]):
             raise ValueError(
                 f"payload crc mismatch ({got_crc:#x} != "
                 f"{int(meta['crc32']):#x}) — corrupt KV pages"
             )
-        arrs = codec.unpack_many(msg.payload, meta["frame_lens"])
+        wire_codec = meta.get("wire_codec")
+        if wire_codec:
+            # Compressed annex: slice the payload by the per-tensor
+            # frame lengths and decode each through the page codec
+            # stack. Decoded tensors are fresh host arrays (the
+            # zero-copy receive contract applies to the raw path
+            # only — a compressed wire trades the view for the
+            # bandwidth).
+            from adapt_tpu.ops.quantize import decode_page
+
+            mv = memoryview(msg.payload)
+            lens = [int(x) for x in meta["frame_lens"]]
+            if sum(lens) != len(mv):
+                raise ValueError(
+                    f"frame lengths sum to {sum(lens)}, payload is "
+                    f"{len(mv)} bytes"
+                )
+            arrs, off = [], 0
+            for ln, lmeta in zip(lens, meta["leaf_meta"]):
+                arrs.append(decode_page(mv[off:off + ln], lmeta))
+                off += ln
+        else:
+            arrs = codec.unpack_many(msg.payload, meta["frame_lens"])
         per_block = 4 if quantized else 2
         if len(arrs) != 1 + n_blocks * per_block:
             raise ValueError(
@@ -629,6 +686,7 @@ class DisaggServer:
         registry=None,
         lease_ttl_s: float = 2.0,
         telemetry_url: str | None = None,
+        wire_codec: str | None = None,
     ):
         if not decode._paged:
             raise ValueError(
@@ -652,6 +710,22 @@ class DisaggServer:
         self.decode = decode
         self.prefill = prefill
         self.cfg = config or DisaggConfig()
+        #: MSG_KV_PAGES wire codec (``pack_handoff``). Explicit arg
+        #: wins; otherwise inherited from the decode batcher's
+        #: ``CacheTierConfig.wire_codec`` when it runs a cache tier
+        #: (ONE config names every tier boundary's codec); "raw" —
+        #: today's zero-copy frames — when neither names one.
+        if wire_codec is None:
+            tier_cfg = getattr(decode, "_tier_cfg", None)
+            wire_codec = tier_cfg.wire_codec if tier_cfg else "raw"
+        from adapt_tpu.ops.quantize import PAGE_CODECS
+
+        if wire_codec not in PAGE_CODECS:
+            raise ValueError(
+                f"wire_codec={wire_codec!r}: expected one of "
+                f"{PAGE_CODECS}"
+            )
+        self.wire_codec = wire_codec
         if self.cfg.busy_prompt_threshold <= decode._page:
             log.warning(
                 "busy_prompt_threshold %d <= page size %d: busy-tier "
@@ -882,11 +956,12 @@ class DisaggServer:
             return  # cancelled between chunk passes and handoff
         t0 = time.perf_counter()
         try:
-            msg = pack_handoff(handoff)
+            msg = pack_handoff(handoff, wire_codec=self.wire_codec)
             wire_bytes = sum(
                 p.nbytes if isinstance(p, memoryview) else len(p)
                 for p in frame_parts(msg)
             )
+            raw_bytes = handoff_raw_nbytes(handoff)
             landed = unpack_handoff(loopback(msg))
             adopted = self.decode.adopt_prefill_pages(
                 landed.prompt,
@@ -899,7 +974,12 @@ class DisaggServer:
             return
         wall = time.perf_counter() - t0
         reg = global_metrics()
+        # handoff_bytes counts WIRE (post-codec) bytes — the frames
+        # actually shipped; handoff_bytes_raw the uncompressed payload,
+        # so the wire compression ratio is raw/bytes on any dashboard
+        # (they coincide when wire_codec == "raw").
         reg.inc("disagg.handoff_bytes", float(wire_bytes))
+        reg.inc("disagg.handoff_bytes_raw", float(raw_bytes))
         reg.inc("disagg.pages_streamed", float(handoff.n_pages))
         reg.observe("disagg.handoff_s", wall)
         global_flight_recorder().record(

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import os
 from functools import partial
 
 import jax
@@ -99,6 +100,17 @@ class Pager:
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.prefix_capacity_skips = 0
+        #: Optional eviction callback ``(page, key) -> None``, invoked
+        #: just BEFORE a registered rc=0 page leaves the pool (LRU
+        #: eviction under allocation pressure, or an ``evict_cached``
+        #: sweep) — the hierarchical-cache seam: a host tier
+        #: (``HostKVTier`` via ``runtime/continuous``) captures the
+        #: page's bytes here so eviction spills instead of killing the
+        #: content. The page's HBM bytes are still readable when the
+        #: hook runs (pools are functional arrays; the new owner's
+        #: write dispatches later), and the hook must not reenter the
+        #: pager.
+        self.evict_hook = None
 
     @property
     def num_allocatable(self) -> int:
@@ -117,6 +129,8 @@ class Pager:
             page, _ = self._lru.popitem(last=False)
             key = self._key_of.pop(page)
             del self._by_key[key]
+            if self.evict_hook is not None:
+                self.evict_hook(page, key)
             return page
         return None
 
@@ -270,9 +284,26 @@ class Pager:
             page, _ = self._lru.popitem(last=False)
             key = self._key_of.pop(page)
             del self._by_key[key]
+            if self.evict_hook is not None:
+                self.evict_hook(page, key)
             self._free.append(page)
             evicted += 1
         return evicted
+
+    def resident(self, key: bytes) -> bool:
+        """True when ``key``'s page is in the pool (owned or cached) —
+        the no-accounting residency probe the host-tier readmit path
+        uses BEFORE ``lookup_share`` (which counts a hit or miss)."""
+        return key in self._by_key
+
+    def cached_pages(self) -> list[tuple[int, bytes]]:
+        """The rc=0 prefix-cache residents with their content keys,
+        oldest (next-evicted) first — the proactive spill sweep's
+        working set. Spill candidates come ONLY from here: a page
+        referenced by a live slot (rc > 0) never appears, which is
+        what keeps lossy host-tier codecs away from live decode
+        state."""
+        return [(p, self._key_of[p]) for p in self._lru]
 
     def register(self, page: int, key: bytes) -> None:
         """Publish ``page`` (currently owned, rc>=1) as the cache entry
@@ -296,6 +327,224 @@ class Pager:
             prefix_hits=self.prefix_hits,
             prefix_misses=self.prefix_misses,
             prefix_capacity_skips=self.prefix_capacity_skips,
+        )
+
+
+@dataclasses.dataclass
+class HostTierStats:
+    pages: int  # host-resident pages (warm + cold; disk excluded)
+    warm: int
+    cold: int
+    disk: int  # pages persisted to the optional disk tier
+    host_bytes: int  # encoded bytes resident in host memory
+    spilled: int  # lifetime pages accepted by put()
+    dropped: int  # lifetime pages that fell off the cold end
+    codec_bytes_saved: int  # lifetime raw - encoded bytes
+
+
+@dataclasses.dataclass
+class _HostPage:
+    """One spilled page: per-block (K, V) members, each member a tuple
+    of ``(payload, meta)`` encoded leaves (one leaf for native pools,
+    ``(values, scales)`` for quantized ones)."""
+
+    blocks: list
+    nbytes: int  # encoded bytes (payload sum)
+    raw_nbytes: int
+
+
+class HostKVTier:
+    """The host-DRAM (optionally disk-backed) spill tier under the
+    :class:`Pager` — ROADMAP item 3's "cache tiers below the Pager".
+
+    Pages evicted from the HBM prefix LRU land here under the SAME
+    content keys the admission probe computes, encoded by the
+    ``ops.quantize`` page codec stack: the WARM sub-tier keeps a
+    lossless codec (readmits are bit-exact), pages demoted past the
+    warm capacity re-encode with the COLD codec (lossy allowed —
+    every page here is rc=0 by construction, never referenced by a
+    live slot), and pages past the total host capacity either persist
+    to ``disk_dir`` or drop (counted). ``get`` decodes a page back to
+    its pool-shaped host arrays for the readmit landing path
+    (``ContinuousBatcher._maybe_readmit`` -> ``Pager.adopt_cached``
+    -> ``_adopt_pages``).
+
+    Plain-python bookkeeping like the Pager itself — no jax, no
+    metrics registry (the batcher bridges the books to ``cache_tier.*``
+    counters and ``memory.host_bytes`` / ``memory.pages_spilled``
+    gauges); thread-safety follows the pager's model (mutations on the
+    ticking thread, ``stats()`` tolerant of racing reads)."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self._warm: collections.OrderedDict[bytes, _HostPage] = (
+            collections.OrderedDict()
+        )
+        self._cold: collections.OrderedDict[bytes, _HostPage] = (
+            collections.OrderedDict()
+        )
+        #: key -> (path, blocks-meta) for disk-persisted pages.
+        self._disk: dict[bytes, tuple[str, list]] = {}
+        self._bytes = 0
+        self.spilled = 0
+        self.dropped = 0
+        self.codec_bytes_saved = 0
+        if cfg.disk_dir:
+            os.makedirs(cfg.disk_dir, exist_ok=True)
+
+    # -- encoding ----------------------------------------------------------
+
+    @staticmethod
+    def _encode(blocks, codec: str) -> _HostPage:
+        from adapt_tpu.ops.quantize import encode_page
+
+        enc, nbytes, raw = [], 0, 0
+        for k, v in blocks:
+            pair = []
+            for member in (k, v):
+                leaves = (
+                    member if isinstance(member, tuple) else (member,)
+                )
+                out = []
+                for leaf in leaves:
+                    payload, meta = encode_page(np.asarray(leaf), codec)
+                    nbytes += len(payload)
+                    raw += meta["raw_nbytes"]
+                    out.append((payload, meta))
+                pair.append(tuple(out))
+            enc.append(tuple(pair))
+        return _HostPage(blocks=enc, nbytes=nbytes, raw_nbytes=raw)
+
+    @staticmethod
+    def _decode(entry: _HostPage) -> list:
+        from adapt_tpu.ops.quantize import decode_page
+
+        blocks = []
+        for km, vm in entry.blocks:
+            pair = []
+            for member in (km, vm):
+                leaves = [decode_page(p, m) for p, m in member]
+                pair.append(
+                    leaves[0] if len(leaves) == 1 else tuple(leaves)
+                )
+            blocks.append(tuple(pair))
+        return blocks
+
+    def _book(self, entry: _HostPage, sign: int) -> None:
+        self._bytes += sign * entry.nbytes
+
+    # -- the tier API ------------------------------------------------------
+
+    def contains(self, key: bytes) -> bool:
+        return (
+            key in self._warm or key in self._cold or key in self._disk
+        )
+
+    def put(self, key: bytes, blocks) -> tuple[int, int]:
+        """Spill one page (per-block ``(K, V)`` host leaves, pool
+        shapes ``(kvh, page, w)``) into the WARM sub-tier under its
+        content key. Idempotent for resident keys (MRU touch only).
+        Returns ``(raw_bytes, encoded_bytes)`` for the caller's
+        accounting."""
+        if self.contains(key):
+            if key in self._warm:
+                self._warm.move_to_end(key)
+            return (0, 0)
+        entry = self._encode(blocks, self.cfg.warm_codec)
+        self._warm[key] = entry
+        self._book(entry, +1)
+        self.spilled += 1
+        self.codec_bytes_saved += entry.raw_nbytes - entry.nbytes
+        self._demote()
+        return (entry.raw_nbytes, entry.nbytes)
+
+    def _demote(self) -> None:
+        """Warm overflow -> COLD (re-encode through the cold codec:
+        warm is lossless, so the cold payload is exactly what a
+        direct cold-encode of the original would hold); cold overflow
+        -> disk when configured, else dropped (counted)."""
+        cold_cap = self.cfg.host_capacity_pages - self.cfg.warm_capacity_pages
+        while len(self._warm) > self.cfg.warm_capacity_pages:
+            key, entry = self._warm.popitem(last=False)
+            self._book(entry, -1)
+            if cold_cap <= 0:
+                self._overflow(key, entry)
+                continue
+            cold = (
+                entry
+                if self.cfg.cold_codec == self.cfg.warm_codec
+                else self._encode(self._decode(entry), self.cfg.cold_codec)
+            )
+            if cold is not entry:
+                self.codec_bytes_saved += entry.nbytes - cold.nbytes
+            self._cold[key] = cold
+            self._book(cold, +1)
+        while (
+            len(self._cold) > max(cold_cap, 0) and self._cold
+        ):
+            key, entry = self._cold.popitem(last=False)
+            self._book(entry, -1)
+            self._overflow(key, entry)
+
+    def _overflow(self, key: bytes, entry: _HostPage) -> None:
+        if not self.cfg.disk_dir:
+            self.dropped += 1
+            return
+        import hashlib
+        import pickle
+
+        path = os.path.join(
+            self.cfg.disk_dir,
+            hashlib.sha256(key).hexdigest()[:32] + ".kvpage",
+        )
+        with open(path, "wb") as f:
+            pickle.dump(entry, f)
+        self._disk[key] = (path, None)
+
+    def get(self, key: bytes):
+        """Decoded per-block ``(K, V)`` host arrays for ``key``, or
+        None. MRU-touches the entry (it stays host-resident after a
+        readmit: the HBM copy is rc=0 evictable and may bounce right
+        back)."""
+        entry = self._warm.get(key)
+        if entry is not None:
+            self._warm.move_to_end(key)
+            return self._decode(entry)
+        entry = self._cold.get(key)
+        if entry is not None:
+            self._cold.move_to_end(key)
+            return self._decode(entry)
+        disk = self._disk.get(key)
+        if disk is not None:
+            import pickle
+
+            try:
+                with open(disk[0], "rb") as f:
+                    entry = pickle.load(f)
+            except OSError:
+                del self._disk[key]
+                return None
+            return self._decode(entry)
+        return None
+
+    @property
+    def pages(self) -> int:
+        return len(self._warm) + len(self._cold)
+
+    @property
+    def host_bytes(self) -> int:
+        return self._bytes
+
+    def stats(self) -> HostTierStats:
+        return HostTierStats(
+            pages=self.pages,
+            warm=len(self._warm),
+            cold=len(self._cold),
+            disk=len(self._disk),
+            host_bytes=self._bytes,
+            spilled=self.spilled,
+            dropped=self.dropped,
+            codec_bytes_saved=self.codec_bytes_saved,
         )
 
 

@@ -28,8 +28,13 @@ silently destroy TPU serving performance without ever failing a test:
   the exporter, like the codec copy-stats bridge) sums them at scrape
   time into ``memory.*`` gauges: dense KV strip bytes, draft-cache
   bytes, paged pool occupancy (``memory.pages_{used,free,cached}`` +
-  ``memory.pool_pages``/``pool_bytes``) and the pager's prefix-cache
-  effectiveness counters (``paged.prefix_{hits,misses}``). Sharded
+  ``memory.pool_pages``/``pool_bytes``), the pager's prefix-cache
+  effectiveness counters (``paged.prefix_{hits,misses}``), and — when
+  a hierarchical cache tier is configured — the host-DRAM tier's
+  occupancy (``memory.host_bytes`` encoded-resident bytes,
+  ``memory.pages_spilled`` host-resident pages; the per-event
+  ``cache_tier.*_total`` counters land at their event sites in
+  ``runtime/continuous``, not here). Sharded
   components report BOTH logical and per-device bytes
   (``memory.kv_bytes_per_device`` / ``memory.pool_bytes_per_device``
   via :func:`device_local_nbytes`) — under tensor parallelism the

@@ -396,6 +396,26 @@ emit(phase="done", status="ok", elapsed_s=round(time.time() - t0, 2))
 
     def _emit(record) -> int:
         record["compile_cache"] = "warm" if cache_warm else "cold"
+        # Stamp the ACTIVE serving-tier CacheTierConfig (capacity +
+        # codecs) into every BENCH record: the trajectory's perf rows
+        # are only comparable when the memory hierarchy behind them is
+        # known — a row measured with a host spill tier under the
+        # Pager is a different serving config from one without.
+        # ADAPT_TPU_CACHE_TIER=1 opts serving runs into the default
+        # config; unset/0 means off (today's single-tier behavior).
+        try:
+            if os.environ.get("ADAPT_TPU_CACHE_TIER", "").lower() in (
+                "1", "on", "true",
+            ):
+                import dataclasses as _dc
+
+                from adapt_tpu.config import CacheTierConfig
+
+                record["cache_tier"] = _dc.asdict(CacheTierConfig())
+            else:
+                record["cache_tier"] = None
+        except Exception:  # cache-tier stamping must never break a row
+            record["cache_tier"] = None
         if notes:
             record["note"] = "; ".join(notes)
         # TPU-blind stamping, greppable from the artifact alone: ANY

@@ -24,6 +24,7 @@ Driver usage (one BENCH-style JSON line on stdout)::
 
     python benchmarks/load/harness.py --rates 4,8,16,32 --seed 0
     python benchmarks/load/harness.py --rates 8 --cancel-pct 50
+    python benchmarks/load/harness.py --preset corpus --cache-tier on
 """
 
 from __future__ import annotations
@@ -353,11 +354,16 @@ def build_batcher(
     layout: str = "slots",
     page_size: int = 128,
     scheduler=None,
+    pool_pages: int | None = None,
+    cache_tier=None,
 ):
     """The harness's model+batcher factory (CPU-forced; tiny LM — the
     harness measures the serving tier's behavior under load, not model
     quality). ``scheduler`` (a ``config.SchedulerConfig``) turns the
-    traffic-control tier on — the quota-on arm of an overload A/B."""
+    traffic-control tier on — the quota-on arm of an overload A/B.
+    ``cache_tier`` (a ``config.CacheTierConfig``; paged only) turns
+    the host-DRAM spill tier on — the tier-on arm of the corpus A/B —
+    and ``pool_pages`` pins the HBM budget so both arms run flat."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
     import jax.numpy as jnp
@@ -371,6 +377,10 @@ def build_batcher(
         jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
     )
     kw = {"page_size": page_size} if layout == "paged" else {}
+    if pool_pages is not None and layout == "paged":
+        kw["pool_pages"] = pool_pages
+    if cache_tier is not None:
+        kw["cache_tier"] = cache_tier
     if scheduler is not None:
         kw["scheduler"] = scheduler
     return ContinuousBatcher(
@@ -445,6 +455,14 @@ def main() -> int:
     sched_arg = str_flag(
         sys.argv, "--scheduler", "off", choices=("off", "on")
     )
+    # Hierarchical KV: "on" puts the host-DRAM spill tier under the
+    # paged prefix cache (default CacheTierConfig) so the SAME seeded
+    # schedule drives tier-on vs tier-off arms — e.g.
+    # `--preset corpus --cache-tier on` vs `--cache-tier off`
+    # (implies --layout paged; the tier has no dense analog).
+    tier_arg = str_flag(
+        sys.argv, "--cache-tier", "off", choices=("off", "on")
+    )
     out = str_flag(sys.argv, "--out", "")
     try:
         rates = [float(r) for r in rates_arg.split(",") if r]
@@ -468,6 +486,12 @@ def main() -> int:
             from adapt_tpu.config import SchedulerConfig
 
             scheduler = SchedulerConfig()
+        cache_tier = None
+        if tier_arg == "on":
+            from adapt_tpu.config import CacheTierConfig
+
+            cache_tier = CacheTierConfig()
+            layout = "paged"
         if placement == "disagg":
             # Same schedule, disaggregated serving path (paged decode +
             # prefill tier) — the apples-to-apples arm of the
@@ -487,6 +511,7 @@ def main() -> int:
                 chunk,
                 layout,
                 scheduler=scheduler,
+                cache_tier=cache_tier,
             )
         # Phase timing on: every curve point gets its roofline
         # annotation (mbu/mfu need measured phase walls).
@@ -512,6 +537,12 @@ def main() -> int:
             "layout": layout,
             "placement": placement,
             "scheduler": sched_arg,
+            # Stamp the ACTIVE CacheTierConfig (capacity/codec/budgets)
+            # so perf rows stay comparable across runs — a tier-on row
+            # and a tier-off row are different serving configs.
+            "cache_tier": (
+                dataclasses.asdict(cache_tier) if cache_tier else None
+            ),
             "preset": preset_name or None,
             "spec": dataclasses.asdict(spec),
             "points": [

@@ -1,0 +1,239 @@
+"""Corpus-preset A/B: the SAME tenant-skewed recurring-prefix traffic
+with and without the host-DRAM KV cache tier, at a FLAT HBM budget.
+
+The ``corpus`` workload preset (``benchmarks/load/workload.PRESETS``)
+recurs shared 96-token prefixes (6 full pages each at this driver's
+page size; the driver widens the corpus to 20 prefixes — 120 distinct
+prefix pages) against an HBM pool deliberately sized several-fold
+smaller (31 allocatable pages), so the prefix LRU alone cannot keep
+the corpus warm: tier OFF, evicted pages die and a returning prefix
+recomputes; tier ON, they spill to host DRAM and readmit through the
+``adopt_cached`` landing path. Two gated records:
+
+- ``load_tier_prefix_multiplier`` — SERVABLE cached prefixes (all 6
+  full pages answerable from the cache hierarchy without recompute,
+  ``ContinuousBatcher.prefix_cached`` at phase drain — a structural
+  capacity count, not a wall-clock one), tier-on / tier-off, the
+  ROADMAP item-3 pin (>= 4x at flat HBM budget: the off arm is bounded
+  by the pool — at most 5 full prefixes can be HBM-resident — while
+  the on arm's host tier holds the whole corpus the phase touched).
+  The driver converts structural failures into error records the gate
+  always fails: an off arm that never evicts (the pool is not under
+  pressure), an on arm that never spills or readmits, or the probe
+  pass's streams diverging between arms (lossless readmits must be
+  bit-exact — every corpus prefix is re-referenced through both arms
+  after the count and compared token-for-token).
+- ``load_tier_itl_p99_ratio`` — the off arm's phase ITL p99 over the
+  on arm's: spill/readmit work is budgeted per tick, so the tier must
+  not inflate decode-tick latency. Gated LOOSELY (CPU wall clock under
+  shared CI; the regression mode is the tier stalling decode ticks by
+  multiples, not jitter).
+
+Usage: ``python benchmarks/load/tier_smoke.py [--seed 0]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks.common import emit, int_flag  # noqa: E402
+from benchmarks.load.harness import (  # noqa: E402
+    build_batcher,
+    drive_phase,
+    warmup,
+)
+from benchmarks.load.workload import (  # noqa: E402
+    build_schedule,
+    preset,
+    schedule_prefixes,
+)
+
+DURATION_S = 2.0
+SLOTS = 2
+CHUNK = 4
+PAGE = 16
+#: Corpus widened past the preset default: 20 prefixes x 6 pages =
+#: 120 distinct prefix pages vs the 31-page pool, with a flat-ish
+#: prefix skew so the whole corpus is touched within the phase.
+PREFIX_POOL = 20
+PREFIX_SKEW = 0.4
+RATE_RPS = 40.0
+#: Flat HBM budget for BOTH arms: covers the 2 slots' worst case
+#: (ceil(188/16) = 12 pages each) plus a thin prefix LRU — far below
+#: the corpus's 120 distinct prefix pages. 29 allocatable pages bound
+#: the off arm at floor(29/6) = 4 fully-resident prefixes by
+#: construction, which is what keeps the >= 4x gate's margin
+#: structural rather than luck.
+POOL_PAGES = 30
+#: Full pages per corpus prefix ((96 + 1 probe token - 1) // 16).
+PREFIX_PAGES = 6
+PROBE_STEPS = 2
+
+_METRICS = (
+    ("load_tier_prefix_multiplier",
+     "servable cached corpus prefixes, tier-on / tier-off"),
+    ("load_tier_itl_p99_ratio",
+     "phase ITL p99, tier-off / tier-on"),
+)
+
+
+def _emit_errors(err: str) -> None:
+    for metric, unit in _METRICS:
+        print(
+            json.dumps(
+                {"metric": metric, "value": 0.0, "unit": unit,
+                 "vs_baseline": 0.0, "error": err}
+            ),
+            flush=True,
+        )
+
+
+def _probe_prompts(prefixes, vocab: int):
+    import numpy as np
+
+    return [
+        np.asarray(tuple(head) + (int(head[0]) % vocab,), np.int32)
+        for head in prefixes
+    ]
+
+
+def _count_servable(bat, prompts) -> int:
+    """Structural capacity count at phase drain: prefixes whose full
+    6 pages the cache hierarchy can answer without recompute
+    (``prefix_cached`` — read-only, so the count itself cannot evict
+    anything)."""
+    return sum(
+        1 for p in prompts if bat.prefix_cached(p) >= PREFIX_PAGES
+    )
+
+
+def _probe_streams(bat, prompts):
+    """Re-reference every corpus prefix (hottest first) and collect
+    the greedy streams — the bit-identity validation pass (run AFTER
+    the servable count; probes churn the caches)."""
+    streams = []
+    for p in prompts:
+        rid = bat.submit(p, PROBE_STEPS)
+        streams.append(bat.run()[rid])
+    return streams
+
+
+def main() -> int:
+    seed = int_flag(sys.argv, "--seed", 0)
+    try:
+        from adapt_tpu.config import CacheTierConfig
+
+        spec = preset(
+            "corpus",
+            duration_s=DURATION_S,
+            rate_rps=RATE_RPS,
+            prefix_pool=PREFIX_POOL,
+            prefix_skew=PREFIX_SKEW,
+        )
+        schedule = build_schedule(spec, seed)
+        prefixes = schedule_prefixes(spec, seed)
+        max_len = spec.prompt_max + spec.steps_max + 8
+        tier = CacheTierConfig(
+            spill_pages_per_tick=16, readmit_pages_per_tick=16
+        )
+        arms: dict[str, dict] = {}
+        for arm, cfg in (("off", None), ("on", tier)):
+            bat = build_batcher(
+                spec.vocab, max_len, SLOTS, CHUNK, layout="paged",
+                page_size=PAGE, pool_pages=POOL_PAGES, cache_tier=cfg,
+            )
+            warmup(bat, spec.vocab, spec.steps_max, spec.prompt_max)
+            report = drive_phase(bat, schedule, spec)
+            prompts = _probe_prompts(prefixes, spec.vocab)
+            servable = _count_servable(bat, prompts)
+            streams = _probe_streams(bat, prompts)
+            st = bat.stats()
+            arms[arm] = {
+                "servable": servable,
+                "streams": streams,
+                "itl_p99": report["itl_s"].get("p99"),
+                "report": {
+                    k: report[k]
+                    for k in ("goodput_tokens_s", "throughput_tokens_s",
+                              "ttft_s", "itl_s", "wall_s",
+                              "schedule_digest")
+                },
+                "prefix_hits": st["prefix_hits"],
+                "prefix_misses": st["prefix_misses"],
+                "spilled": st.get("tier_spilled", 0),
+                "readmitted": st.get("tier_readmitted", 0),
+                "dropped": st.get("tier_dropped", 0),
+                "host_pages": st.get("host_pages", 0),
+            }
+            bat.close()
+
+        errors: list[str] = []
+        off, on = arms["off"], arms["on"]
+        if off["prefix_misses"] <= len(prefixes):
+            errors.append(
+                "off arm barely missed — the pool is not under "
+                f"pressure (misses {off['prefix_misses']})"
+            )
+        if on["spilled"] == 0 or on["readmitted"] == 0:
+            errors.append(
+                f"tier never engaged (spilled {on['spilled']}, "
+                f"readmitted {on['readmitted']})"
+            )
+        if off["servable"] >= len(prefixes):
+            errors.append(
+                "off arm served the whole corpus from HBM — shrink "
+                "POOL_PAGES, the A/B measures nothing"
+            )
+        import numpy as np
+
+        for i, (a, b) in enumerate(zip(off["streams"], on["streams"])):
+            if not np.array_equal(a, b):
+                errors.append(
+                    f"probe {i} streams diverged between arms"
+                )
+                break
+        if errors:
+            _emit_errors("; ".join(errors)[-300:])
+            return 0
+
+        multiplier = on["servable"] / max(off["servable"], 1)
+        extras = {
+            arm: {k: v for k, v in d.items() if k != "streams"}
+            for arm, d in arms.items()
+        }
+        emit(
+            "load_tier_prefix_multiplier",
+            round(multiplier, 4),
+            "x (servable cached prefixes, on/off)",
+            round(multiplier - 4.0, 4),
+            seed=seed,
+            corpus_prefixes=len(prefixes),
+            pool_pages=POOL_PAGES,
+            servable_on=on["servable"],
+            servable_off=off["servable"],
+            arms=extras,
+        )
+        p99_off = off["itl_p99"] or 0.0
+        p99_on = on["itl_p99"] or 0.0
+        ratio = (p99_off / p99_on) if p99_on else 1.0
+        emit(
+            "load_tier_itl_p99_ratio",
+            round(ratio, 4),
+            "x (off/on; < 1 means the tier slowed decode ticks)",
+            round(ratio - 1.0, 4),
+            seed=seed,
+            itl_p99_off=p99_off,
+            itl_p99_on=p99_on,
+        )
+    except Exception as e:  # noqa: BLE001 — always one JSON line, rc 0
+        _emit_errors(str(e)[-300:])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

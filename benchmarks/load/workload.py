@@ -66,6 +66,18 @@ class WorkloadSpec:
     #: cancellation after ``cancel_after_tokens`` emitted tokens.
     cancel_fraction: float = 0.0
     cancel_after_tokens: int = 4
+    #: Recurring-prefix corpus (the "corpus" preset): when
+    #: ``prefix_pool`` > 0, every prompt is one of ``prefix_pool``
+    #: deterministic shared prefixes of ``prefix_len`` tokens
+    #: (Zipf-weighted by rank at ``prefix_skew`` — conversation
+    #: histories recur skewed, not uniformly) followed by a fresh
+    #: lognormal tail — so the paged prefix cache sees the same full
+    #: pages again and again, and total distinct prefix pages can be
+    #: sized to exceed the HBM pool several-fold (what the host-tier
+    #: A/B needs).
+    prefix_pool: int = 0
+    prefix_len: int = 0
+    prefix_skew: float = 0.8
 
     def __post_init__(self):
         if self.arrival not in ("poisson", "deterministic"):
@@ -86,6 +98,17 @@ class WorkloadSpec:
             )
         if not self.tenants:
             raise ValueError("tenants must be non-empty")
+        if self.prefix_pool < 0 or self.prefix_len < 0:
+            raise ValueError("prefix_pool/prefix_len must be >= 0")
+        if bool(self.prefix_pool) != bool(self.prefix_len):
+            raise ValueError(
+                "prefix_pool and prefix_len must be set together"
+            )
+        if self.prefix_len and self.prefix_len >= self.prompt_max:
+            raise ValueError(
+                f"prefix_len {self.prefix_len} leaves no room for a "
+                f"tail under prompt_max {self.prompt_max}"
+            )
 
 
 #: Named workload presets (``preset(name)`` materializes one).
@@ -128,6 +151,30 @@ PRESETS: dict[str, dict] = {
     # rate per run (a saturating burst measures the box's actual
     # capacity, then the schedule offers exactly 2x it), so the gate
     # holds on loaded CI boxes where the idle number is 3-5x off.
+    # The CORPUS preset: a tenant-skewed conversation corpus of
+    # RECURRING prefixes whose total full pages are sized (by the
+    # driver's pool_pages choice) to exceed the HBM pool several-fold
+    # — the regime ROADMAP item 3 names, where the prefix LRU alone
+    # cannot keep the working corpus warm and evicted pages either die
+    # (tier off) or spill to host DRAM and readmit (tier on).
+    # benchmarks/load/tier_smoke.py drives the same seeded schedule
+    # through both arms (`harness.py --preset corpus --cache-tier
+    # on|off` reproduces them by hand) and gates the servable-prefix
+    # multiplier at flat HBM budget.
+    "corpus": dict(
+        rate_rps=24.0,
+        prompt_median=4,
+        prompt_sigma=0.5,
+        prompt_max=160,
+        steps_median=6,
+        steps_sigma=0.4,
+        steps_max=12,
+        prefix_pool=12,
+        prefix_len=96,
+        prefix_skew=0.6,
+        ttft_budget_s=3.0,
+        itl_budget_s=2.0,
+    ),
     "overload": dict(
         rate_rps=960.0,
         prompt_median=6,
@@ -155,6 +202,24 @@ def preset(name: str, **overrides) -> WorkloadSpec:
             f"unknown preset {name!r}; have {sorted(PRESETS)}"
         ) from None
     return WorkloadSpec(**{**base, **overrides})
+
+
+def schedule_prefixes(
+    spec: WorkloadSpec, seed: int
+) -> list[tuple[int, ...]]:
+    """The corpus preset's shared prefixes — a pure function of
+    ``(spec, seed)`` on its OWN rng stream (decoupled from the
+    arrival stream, so a driver can reconstruct the prefix list to
+    probe servability without replaying the whole schedule)."""
+    if not spec.prefix_pool:
+        return []
+    rng = np.random.RandomState(seed * 1_000_003 + 17)
+    return [
+        tuple(
+            int(x) for x in rng.randint(0, spec.vocab, size=spec.prefix_len)
+        )
+        for _ in range(spec.prefix_pool)
+    ]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -201,6 +266,13 @@ def build_schedule(spec: WorkloadSpec, seed: int) -> list[Arrival]:
     )
     weights /= weights.sum()
     prio_map = dict(spec.tenant_priorities)
+    prefixes = schedule_prefixes(spec, seed)
+    if prefixes:
+        pweights = np.array(
+            [1.0 / (r + 1) ** spec.prefix_skew
+             for r in range(len(prefixes))]
+        )
+        pweights /= pweights.sum()
     out: list[Arrival] = []
     for t in times:
         plen = _lognormal_len(
@@ -209,9 +281,21 @@ def build_schedule(spec: WorkloadSpec, seed: int) -> list[Arrival]:
         steps = _lognormal_len(
             rng, spec.steps_median, spec.steps_sigma, spec.steps_max
         )
-        prompt = tuple(
-            int(x) for x in rng.randint(0, spec.vocab, size=plen)
-        )
+        if prefixes:
+            # Recurring-prefix prompt: shared prefix + fresh tail (the
+            # lognormal draw above becomes the TAIL length, capped so
+            # the whole prompt stays under prompt_max).
+            head = prefixes[
+                int(rng.choice(len(prefixes), p=pweights))
+            ]
+            tail_len = min(plen, spec.prompt_max - spec.prefix_len)
+            prompt = head + tuple(
+                int(x) for x in rng.randint(0, spec.vocab, size=tail_len)
+            )
+        else:
+            prompt = tuple(
+                int(x) for x in rng.randint(0, spec.vocab, size=plen)
+            )
         tenant = spec.tenants[
             int(rng.choice(len(spec.tenants), p=weights))
         ]

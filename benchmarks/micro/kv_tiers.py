@@ -1,0 +1,230 @@
+"""Hierarchical KV cache tier: spill -> evict -> readmit structural
+checks across the native/int8 pool grid, plus the lossy COLD-codec
+quality bar.
+
+The host tier's claims are STRUCTURAL, like the quant/tp drivers': an
+evicted prefix page spills to host DRAM instead of dying, readmits
+through the ``Pager.adopt_cached``/``_adopt_pages`` landing path on the
+next prefix probe, and the readmitted stream is BIT-IDENTICAL to an
+uninterrupted big-pool run at lossless settings — while spill work
+stays inside the per-tick budget. This driver pins all of it on a tiny
+paged batcher and emits TWO gated records:
+
+- ``micro_kv_tiers_roundtrip_exact`` — 1.0 when, for BOTH pool dtypes
+  (native f32 and int8 values+scales):
+  (a) a prefix whose pages were evicted under flood pressure and
+      host-spilled readmits on re-reference (``cache_tier.readmitted``
+      > 0) and the re-referenced greedy stream equals the
+      uninterrupted run token-for-token;
+  (b) readmits land as prefix-cache hits (``paged.prefix_hits`` moves);
+  (c) the per-tick spill budget is respected (no tick spills more than
+      ``spill_pages_per_tick``; evictions past it count ``dropped``);
+  (d) the pool partition (used + free + cached == allocatable) stays
+      exact with the tier attached.
+  Any violation becomes an ``error`` record the gate always fails.
+- ``micro_kv_tiers_cold_top1_agreement`` — greedy-stream top-1
+  agreement of a readmit through a LOSSY cold tier (warm capacity 0,
+  ``cold_codec="int8"`` — the per-vector absmax lattice) vs the
+  uncompressed reference stream; gated >= 0.95, the same bar as the
+  int4 KV pools. Lossy codecs only ever touch rc=0 spilled pages —
+  live-slot state never routes through them (pinned in
+  tests/test_kv_tiers.py).
+
+Usage: ``python benchmarks/micro/kv_tiers.py [--floods 4]``
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks.common import emit, int_flag  # noqa: E402
+
+PAGE = 8
+POOL_PAGES = 12  # allocatable 11: one slot's worst case + a thin LRU
+STEPS = 8
+
+
+def _mk(lm, variables, pool_pages, tier=None, dtype="native"):
+    from adapt_tpu.runtime.continuous import ContinuousBatcher
+
+    kw = dict(
+        slots=1, chunk=4, kv_layout="paged", page_size=PAGE,
+        pool_pages=pool_pages, kv_cache_dtype=dtype,
+    )
+    if tier is not None:
+        kw["cache_tier"] = tier
+    return ContinuousBatcher(lm, variables, **kw)
+
+
+def _roundtrip(lm, variables, dtype, tier, floods, errors, extras):
+    """Flood-evict a registered prefix, re-reference it, compare to the
+    uninterrupted big-pool stream. Returns the tier batcher's stats."""
+    import numpy as np
+
+    rng = np.random.RandomState(7)
+    A = rng.randint(0, 61, size=2 * PAGE + 4).astype(np.int32)
+    flood = [
+        rng.randint(0, 61, size=2 * PAGE + 4).astype(np.int32)
+        for _ in range(floods)
+    ]
+    tag = f"{dtype}"
+    ref = _mk(lm, variables, 64, dtype=dtype)
+    ref.submit(A, STEPS)
+    ref.run()
+    for p in flood:
+        ref.submit(p, STEPS)
+    ref.run()
+    r2 = ref.submit(A, STEPS)
+    want = ref.run()[r2]
+    ref.close()
+
+    bat = _mk(lm, variables, POOL_PAGES, tier=tier, dtype=dtype)
+    bat.submit(A, STEPS)
+    bat.run()
+    spilled_last, budget = bat.stats()["tier_spilled"], (
+        tier.spill_pages_per_tick
+    )
+    for p in flood:
+        bat.submit(p, STEPS)
+        # Budget check at every tick boundary while the flood evicts.
+        while bat.tick() or bat.stats()["queued"]:
+            s = bat.stats()["tier_spilled"]
+            if s - spilled_last > budget:
+                errors.append(
+                    f"{tag}: tick spilled {s - spilled_last} > budget "
+                    f"{budget}"
+                )
+            spilled_last = s
+    st = bat.stats()
+    hits0 = st["prefix_hits"]
+    if st["tier_spilled"] == 0:
+        errors.append(f"{tag}: flood evicted without a single spill")
+    b2 = bat.submit(A, STEPS)
+    got = bat.run()[b2]
+    st = bat.stats()
+    if not np.array_equal(got, want):
+        errors.append(
+            f"{tag}: readmitted stream diverged "
+            f"({got.tolist()} vs {want.tolist()})"
+        )
+    if st["tier_readmitted"] < 1:
+        errors.append(f"{tag}: re-reference readmitted nothing")
+    if st["prefix_hits"] - hits0 < st["tier_readmitted"]:
+        errors.append(
+            f"{tag}: readmits not counted as prefix hits "
+            f"({st['prefix_hits'] - hits0} hits for "
+            f"{st['tier_readmitted']} readmits)"
+        )
+    alloc = st["pool_pages"] - 1
+    if st["pages_in_use"] + (st["pages_free"] - st["pages_cached"]) \
+            + st["pages_cached"] != alloc:
+        errors.append(f"{tag}: pool partition broke: {st}")
+    extras[f"{tag}_spilled"] = st["tier_spilled"]
+    extras[f"{tag}_readmitted"] = st["tier_readmitted"]
+    extras[f"{tag}_dropped"] = st["tier_dropped"]
+    extras[f"{tag}_host_bytes"] = st["host_bytes"]
+    bat.close()
+    return want
+
+
+def main() -> int:
+    floods = int_flag(sys.argv, "--floods", 4)
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from adapt_tpu.config import CacheTierConfig
+        from adapt_tpu.models.transformer_lm import transformer_lm
+        from adapt_tpu.utils.profiling import global_compile_sentinel
+
+        # Many fresh batchers in one process: their first compiles are
+        # legitimate — disarm the alarm (the quant_serving rationale).
+        global_compile_sentinel().warmup_samples = 10**9
+        lm = transformer_lm(61, 32, 2, 2, 64, max_len=64)
+        variables = lm.graph.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+        )
+        errors: list[str] = []
+        extras: dict = {}
+        tier = CacheTierConfig(
+            host_capacity_pages=64,
+            warm_capacity_pages=64,
+            spill_pages_per_tick=4,
+            readmit_pages_per_tick=8,
+        )
+        want = None
+        for dtype in ("native", "int8"):
+            w = _roundtrip(
+                lm, variables, dtype, tier, floods, errors, extras
+            )
+            if dtype == "native":
+                want = w
+
+        # Lossy COLD arm: warm capacity 0 demotes every spill straight
+        # through the int8 page codec; the readmitted stream's top-1
+        # agreement vs the uncompressed reference gates >= 0.95.
+        cold = CacheTierConfig(
+            host_capacity_pages=64,
+            warm_capacity_pages=0,
+            cold_codec="int8",
+            spill_pages_per_tick=8,
+            readmit_pages_per_tick=8,
+        )
+        rng = np.random.RandomState(7)
+        A = rng.randint(0, 61, size=2 * PAGE + 4).astype(np.int32)
+        flood = [
+            rng.randint(0, 61, size=2 * PAGE + 4).astype(np.int32)
+            for _ in range(floods)
+        ]
+        bat = _mk(lm, variables, POOL_PAGES, tier=cold)
+        bat.submit(A, STEPS)
+        bat.run()
+        for p in flood:
+            bat.submit(p, STEPS)
+        bat.run()
+        b2 = bat.submit(A, STEPS)
+        got = bat.run()[b2]
+        st = bat.stats()
+        if st["tier_readmitted"] < 1:
+            errors.append("cold arm: re-reference readmitted nothing")
+        n = min(len(got), len(want))
+        agreement = (
+            float((got[:n] == want[:n]).sum()) / n if n else 0.0
+        )
+        extras["cold_agreement_tokens"] = n
+        extras["cold_readmitted"] = st["tier_readmitted"]
+        bat.close()
+
+        if errors:
+            err = "; ".join(errors)[-300:]
+            emit("micro_kv_tiers_roundtrip_exact", 0.0, "bool", 0.0,
+                 error=err, **extras)
+            emit("micro_kv_tiers_cold_top1_agreement", 0.0, "fraction",
+                 0.0, error=err)
+            return 0
+        emit(
+            "micro_kv_tiers_roundtrip_exact", 1.0, "bool", 0.0,
+            floods=floods, pool_pages=POOL_PAGES, **extras,
+        )
+        emit(
+            "micro_kv_tiers_cold_top1_agreement",
+            round(agreement, 4),
+            "fraction",
+            round(agreement - 0.95, 4),
+            floods=floods,
+        )
+    except Exception as e:  # noqa: BLE001 — always one JSON line, rc 0
+        emit("micro_kv_tiers_roundtrip_exact", 0.0, "bool", 0.0,
+             error=str(e)[-300:])
+        emit("micro_kv_tiers_cold_top1_agreement", 0.0, "fraction", 0.0,
+             error=str(e)[-300:])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -330,6 +330,64 @@ def test_paged_memory_gauges_partition_pool(
     assert snap["max"] >= 2.0
 
 
+def test_paged_memory_gauges_partition_with_cache_tier(
+    lm_setup, isolated_memory_sources
+):
+    """Satellite pin (ISSUE 14): with the host tier attached, the HBM
+    partition (used + free + cached == pool_pages) stays exact
+    MID-FLIGHT while pages spill, and the spilled books are served as
+    their own gauges (``memory.pages_spilled`` / ``memory.host_bytes``
+    — a copy BELOW the pool, never double-counted in the
+    partition)."""
+    from adapt_tpu.config import CacheTierConfig
+
+    lm, variables = lm_setup
+    pool_pages = 12
+    bat = ContinuousBatcher(
+        lm, variables, slots=1, chunk=2, kv_layout="paged", page_size=8,
+        pool_pages=pool_pages,
+        cache_tier=CacheTierConfig(
+            spill_pages_per_tick=16, readmit_pages_per_tick=16
+        ),
+    )
+    register_memory_source("continuous", bat)
+    reg = MetricsRegistry()
+    reg.register_collector(engine_collector)
+
+    def check_partition():
+        g = reg.snapshot()["gauges"]
+        assert (
+            g["memory.pages_used"] + g["memory.pages_free"]
+            + g["memory.pages_cached"]
+            == g["memory.pool_pages"]
+        )
+        return g
+
+    rng = np.random.RandomState(0)
+    first = rng.randint(1, 30, size=17).astype(np.int32)
+    bat.submit(first, 8)
+    bat.tick()  # mid-flight
+    check_partition()
+    # Flood until the first prompt's registered pages spill, checking
+    # the partition at every boundary the books move across.
+    for _ in range(4):
+        bat.submit(rng.randint(1, 30, size=17).astype(np.int32), 8)
+        bat.run()
+        check_partition()
+    g = check_partition()
+    assert g["memory.pages_spilled"] >= 1
+    assert g["memory.host_bytes"] > 0
+    assert g["memory.pages_spilled"] == float(bat._tier.pages)
+    # Readmit on re-reference: partition still exact, spilled gauge
+    # tracks the tier (readmitted pages STAY host-resident — MRU).
+    bat.submit(first, 4)
+    bat.run()
+    g = check_partition()
+    assert bat.stats()["tier_readmitted"] >= 1
+    assert g["memory.pages_spilled"] == float(bat._tier.pages)
+    bat.close()
+
+
 def test_dense_memory_gauges_match_strip_shapes(
     lm_setup, isolated_memory_sources
 ):
