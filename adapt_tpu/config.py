@@ -351,6 +351,66 @@ class DisaggConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class PrefillConfig:
+    """Sequence-parallel LONG-CONTEXT prefill
+    (``parallel/sp_prefill.SPPrefiller``; ``docs/SERVING.md``
+    "Sequence-parallel prefill").
+
+    A prompt of at least ``sp_threshold`` tokens prefills SP-SHARDED:
+    the token axis splits over an ``sp`` mesh axis, every chip
+    computes its own chunk's projections/MLP sequence-locally, the
+    K/V window circulates the ring (``lax.ppermute`` neighbor hops —
+    the ring-attention communication pattern), and each chip's
+    attention-score block is its chunk's rows only — so the O(S^2)
+    prefill wall for one long prompt drops ~linearly with
+    ``sp_width`` instead of monopolizing one chip. The resulting
+    pages land through the SAME ``KVHandoffPlan`` /
+    ``Pager.adopt_cached`` / ``_adopt_pages`` path as a disaggregated
+    handoff (head-resharded sender-side, per 2211.05322), so the
+    request then admits as an ordinary prefix-cache hit and decode
+    stays tp-sharded and untouched; pages are byte-equal to what the
+    single-device chunked prefill would have written (pinned).
+
+    Wired at both entry points: ``ContinuousBatcher`` collocated
+    admission and the ``runtime/disagg.PrefillWorker`` tier (whose
+    ``step()`` dispatches sp-eligible jobs to the sp program instead
+    of the chunk loop). Requires ``kv_layout='paged'`` — the landing
+    path IS the paged prefix cache."""
+
+    #: Prompts with at least this many tokens prefill sp-sharded
+    #: (``None`` disables the sp path entirely). Keep it well above a
+    #: page: below a few pages the ring hops cost more than the
+    #: score-block split saves (see SERVING.md "when chunked-on-one-
+    #: chip wins").
+    sp_threshold: int | None = None
+    #: Mesh size along ``sp_axis`` — the number of sequence shards
+    #: (power of two; 1 turns the sp path off). Composes with tensor
+    #: parallelism as an ``(sp, tp)`` mesh: ``sp_width * tp`` devices.
+    sp_width: int = 1
+    #: Mesh axis name the token-axis split lands on.
+    sp_axis: str = "sp"
+
+    def __post_init__(self):
+        if self.sp_width < 1 or (self.sp_width & (self.sp_width - 1)):
+            raise ValueError(
+                f"sp_width must be a power of two >= 1, got "
+                f"{self.sp_width}"
+            )
+        if self.sp_threshold is not None and self.sp_threshold < 1:
+            raise ValueError(
+                f"sp_threshold must be >= 1, got {self.sp_threshold}"
+            )
+        if not self.sp_axis:
+            raise ValueError("sp_axis must be a non-empty mesh axis name")
+
+    @property
+    def enabled(self) -> bool:
+        """The sp path is live: a threshold is set and there is a ring
+        to split over."""
+        return self.sp_threshold is not None and self.sp_width > 1
+
+
+@dataclasses.dataclass(frozen=True)
 class CacheTierConfig:
     """Hierarchical KV cache: a host-DRAM (optionally disk-backed)
     spill tier UNDER the paged prefix cache (``runtime/paged.HostKVTier``
@@ -714,6 +774,9 @@ class ServeConfig:
     )
     scheduler: SchedulerConfig = dataclasses.field(
         default_factory=SchedulerConfig
+    )
+    prefill: PrefillConfig = dataclasses.field(
+        default_factory=PrefillConfig
     )
     #: Hierarchical KV cache tier (None = off: evicted prefix pages
     #: die, today's behavior). Opt-in, unlike the sibling subsystem

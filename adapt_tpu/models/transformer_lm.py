@@ -46,7 +46,7 @@ from adapt_tpu.ops.paged_attention import (
     pool_values,
 )
 from adapt_tpu.models.moe import MoEDecoderMlp
-from adapt_tpu.ops.quantize import quantize_kv_vectors
+from adapt_tpu.ops.quantize import quantize_kv_vectors, unpack_int4
 
 _NEG_INF = -1e30
 
@@ -472,6 +472,95 @@ class CausalSelfAttention(nn.Module):
         o = jnp.swapaxes(o, 1, 2).reshape(b, c, self.dim)
         return self.out(o), k_pool, v_pool
 
+    def prefill_sp(self, x, gather, quantize_cache=False, constrain=None):
+        """SEQUENCE-PARALLEL prefill body: the whole span's attention
+        in one layer-synchronous pass, written so every per-row
+        operation mirrors the computation :meth:`prefill_chunk_paged`
+        runs for that row, op for op — the sp-sharded prefill program
+        (``parallel/sp_prefill``) is byte-equal to the single-device
+        chunked prefill at the pinned test shapes, and shares chunked
+        prefill's documented ulp fine print beyond them (see the
+        sp_prefill module docstring).
+
+        ``x`` is (1, S, d) with the S axis sp-sharded under GSPMD
+        (projections, rope, quantization and the MLP are all
+        token-local, so they compute shard-locally for free).
+        ``gather`` is the caller's window collective — the ring
+        collect in ``parallel/sp_prefill.ring_collect`` (K/V blocks
+        rotate via ``lax.ppermute`` neighbor hops; each rank
+        accumulates the full window) — applied to the POOL
+        REPRESENTATION of K/V, exactly what the paged pools would
+        hold: ``quantize_cache`` False keeps native dtype,
+        ``"int8"``/``"int4"`` quantize with the shared absmax scheme
+        (int4 packed two nibbles per lane) BEFORE the window is read,
+        so the chunk-attends-the-already-quantized-window fine print
+        of chunked prefill is reproduced exactly. The attention math
+        mirrors ``paged_chunk_attention_reference`` op for op (f32
+        scores, scale columns, -1e30 mask, softmax, scale-weighted
+        probabilities) with the mask ``col <= row`` — per-row
+        identical to any chunk schedule's mask, with trailing bucket
+        padding contributing exact zeros.
+
+        Returns ``(out, cache_k, cache_v)`` where the caches are the
+        pool-representation ``(1, kv_h, S, w)`` arrays (or
+        ``(values, scales)`` tuples) in sequence order — the caller
+        slices them into page-major handoff blocks.
+
+        ``constrain`` (optional) pins the attention intermediates'
+        row axis to the caller's sp sharding
+        (``with_sharding_constraint`` on ``(1, kv_h, rows, X)``
+        arrays): without it GSPMD's propagation may replicate the
+        score block — every rank computing every row — which is
+        numerically identical but forfeits exactly the O(S^2/P)
+        compute split this path exists for. Resharding never changes
+        values, so the byte-equality contract is constraint-blind."""
+        b, s, d = x.shape
+        q, k, v = self._project(x)
+        q, k = self._rope_qk(q, k, jnp.arange(s))
+        if quantize_cache:
+            dt = "int4" if quantize_cache == "int4" else "int8"
+            ck = self._quantize_kv(k, dt)
+            cv = self._quantize_kv(v, dt)
+        else:
+            ck, cv = k, v
+        kg = gather(ck)
+        vg = gather(cv)
+        pin = constrain if constrain is not None else (lambda t: t)
+        q = pin(self._group_q(q))  # (1, kv_h, g*S, hd), rows sp-sharded
+        sm = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+        if quantize_cache:
+            kv_, ksc = kg
+            vv_, vsc = vg
+            if kv_.shape[-1] * 2 == q.shape[-1]:  # packed int4 nibbles
+                kv_, vv_ = unpack_int4(kv_), unpack_int4(vv_)
+            s_ = jnp.einsum(
+                "bhqd,bhkd->bhqk",
+                q.astype(jnp.float32),
+                kv_.astype(jnp.float32),
+            ) * jnp.swapaxes(ksc, 2, 3) * sm
+        else:
+            kv_, vv_ = kg, vg
+            s_ = jnp.einsum(
+                "bhqd,bhkd->bhqk",
+                q.astype(jnp.float32),
+                kv_.astype(jnp.float32),
+            ) * sm
+        rows = jnp.arange(q.shape[2]) % s  # folded row -> position
+        cols = jnp.arange(s)
+        live = cols[None, :] <= rows[:, None]
+        if self.window is not None:
+            live = live & (cols[None, :] > rows[:, None] - self.window)
+        s_ = pin(jnp.where(live[None, None], s_, -1e30))
+        p = jax.nn.softmax(s_, axis=-1)
+        if quantize_cache:
+            p = p * jnp.swapaxes(vsc, 2, 3)
+        o = pin(jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vv_.astype(jnp.float32)
+        ).astype(q.dtype))
+        o = self._ungroup_o(o, s)  # (1, h, S, hd)
+        o = jnp.swapaxes(o, 1, 2).reshape(b, s, d)
+        return self.out(o), ck, cv
+
     def verify_chunk(self, x, cache_k, cache_v, index, tree_tail=0):
         """Append a CHUNK of ``K`` tokens at positions
         ``index..index+K-1`` in ONE cached pass — the speculative-decode
@@ -671,6 +760,13 @@ class DecoderBlock(nn.Module):
         )
         x = x + a
         return x + self._mlp(self.ln2(x)), kp, vp
+
+    def prefill_sp(self, x, gather, quantize_cache=False, constrain=None):
+        a, ck, cv = self.attn.prefill_sp(
+            self.ln1(x), gather, quantize_cache, constrain
+        )
+        x = x + a
+        return x + self._mlp(self.ln2(x)), ck, cv
 
     def verify_chunk(self, x, cache_k, cache_v, index, tree_tail=0):
         a, ck, cv = self.attn.verify_chunk(

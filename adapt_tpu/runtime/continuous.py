@@ -266,6 +266,7 @@ from adapt_tpu.config import (
     CacheTierConfig,
     KernelConfig,
     ParallelConfig,
+    PrefillConfig,
     RecoveryConfig,
     SchedulerConfig,
     SLOSpec,
@@ -289,6 +290,7 @@ from adapt_tpu.parallel.sharding import (
     plan_kv_reshard,
     tree_shardings,
 )
+from adapt_tpu.parallel.sp_prefill import SPPrefiller, build_sp_mesh
 from adapt_tpu.runtime.paged import (
     HostKVTier,
     Pager,
@@ -467,6 +469,8 @@ class ContinuousBatcher:
         scheduler: SchedulerConfig | None = None,
         kernel: KernelConfig | None = None,
         cache_tier: CacheTierConfig | None = None,
+        prefill: PrefillConfig | None = None,
+        sp_mesh: Mesh | None = None,
     ):
         self.lm = lm
         # -- tensor parallelism (mesh-native serving) ----------------------
@@ -891,6 +895,67 @@ class ContinuousBatcher:
         #: recycling) — a steady-state paged tick stages nothing.
         self._table_dev = None
         self._table_snapshot = None
+        # -- sequence-parallel long-context prefill ------------------------
+        #: ``config.PrefillConfig{sp_threshold, sp_width}``: admissions
+        #: of at least the threshold prefill SP-SHARDED across a
+        #: dedicated ``(sp,)`` / ``(sp, tp)`` mesh
+        #: (``parallel/sp_prefill.SPPrefiller`` — ring-transported
+        #: window, chunk-oracle attention) and their pages land through
+        #: :meth:`adopt_prefill_pages` exactly like a disaggregated
+        #: handoff, so the request then admits as a prefix-cache hit
+        #: and the decode tier's mesh/programs are untouched. Byte-
+        #: equal to the collocated chunked prefill (pinned), so greedy
+        #: streams stay bit-identical. The prefiller's tp must MATCH
+        #: this batcher's (its pages must be what THIS batcher's own
+        #: chunked prefill would write, which is tp-sharded math for
+        #: tp > 1).
+        self._sp_cfg = prefill
+        self._sp: SPPrefiller | None = None
+        self._sp_prefills = 0
+        #: Consecutive sp-dispatch failures: past the breaker the
+        #: prefiller retires (every long admission was paying a doomed
+        #: dispatch — e.g. a dead ring-only device no batcher-mesh
+        #: event will ever recover) until a recovery rebuilds it.
+        self._sp_failures = 0
+        if prefill is not None and prefill.enabled:
+            if not self._paged:
+                raise ValueError(
+                    "PrefillConfig sp prefill requires "
+                    "kv_layout='paged' (the sp pages land through the "
+                    "paged prefix cache)"
+                )
+            mesh_sp = sp_mesh
+            if mesh_sp is None:
+                mesh_sp = build_sp_mesh(
+                    prefill.sp_width, self._tp, prefill.sp_axis,
+                    self._axis,
+                )
+            if self._tp > 1:
+                sp_tp_axis = self._axis
+                if (
+                    sp_tp_axis not in mesh_sp.shape
+                    or int(mesh_sp.shape[sp_tp_axis]) != self._tp
+                ):
+                    raise ValueError(
+                        f"sp_mesh must carry the batcher's tp axis "
+                        f"{sp_tp_axis!r} at size {self._tp} — sp pages "
+                        "must be what this batcher's own tp-sharded "
+                        "chunked prefill would write"
+                    )
+            else:
+                sp_tp_axis = (
+                    self._axis if self._axis in mesh_sp.shape else None
+                )
+            self._sp = SPPrefiller(
+                lm, self.variables, mesh_sp, self._page,
+                kv_cache_dtype=kv_cache_dtype,
+                sp_axis=prefill.sp_axis,
+                tp_axis=sp_tp_axis,
+                name="batcher-sp",
+            )
+            global_metrics().set_gauge(
+                "prefill.sp_width", float(self._sp.sp)
+            )
         # -- traffic control (docs/SERVING.md "Traffic control") -----------
         #: The submit queue is a runtime/scheduler.AdmissionQueue even
         #: without an explicit SchedulerConfig: bounded (the default
@@ -1722,6 +1787,82 @@ class ContinuousBatcher:
             self._caches, pages_dev, placed, epoch=self._mesh_epoch
         )
         return na
+
+    def _sp_admit(self, req: "_Request") -> None:
+        """Sequence-parallel prefill of one long admission: run the
+        sp-sharded whole-span program (``parallel/sp_prefill``) and
+        land its page-major blocks through :meth:`adopt_prefill_pages`
+        — the disaggregated-handoff landing path, loopbacked in
+        process — so the admission below then prefix-hits every full
+        page and pays only the suffix pass. Failures degrade to the
+        ordinary (chunked) prefill: sp is an optimization, never a
+        correctness gate."""
+        s0 = req.prompt.shape[0]
+        m = self._sp.covers(s0)
+        if m < 1:
+            return
+        if self.prefix_cached(req.prompt) >= m:
+            return  # hierarchy-resident: nothing to compute
+        eo = self._eobs
+        eo_on = eo.enabled
+        t_ph = eo.now() if eo_on else 0.0
+        tracer = global_tracer()
+        t0 = tracer.now() if tracer.enabled else 0.0
+        try:
+            n, blocks = self._sp.prefill(req.prompt)
+            adopted = self.adopt_prefill_pages(
+                req.prompt, blocks, self._page,
+                self._kv_dtype if self._kv_quant else False,
+            )
+        except Exception:  # noqa: BLE001 — degrade, never wedge
+            log.exception(
+                "sp prefill failed for request %d; admission falls "
+                "back to the chunked path", req.req_id,
+            )
+            global_flight_recorder().record(
+                "sp_prefill", request=req.req_id, pages=0,
+                sp=self._sp.sp, ok=False,
+            )
+            self._sp_failures += 1
+            if self._sp_failures >= 3:
+                # Deterministic failure (a dead ring-only device, a
+                # broken placement): stop paying a doomed dispatch per
+                # long admission — retire the ring until a recovery
+                # rebuilds it.
+                log.warning(
+                    "sp prefill disabled after %d consecutive "
+                    "failures", self._sp_failures,
+                )
+                self._sp.close()
+                self._sp = None
+                global_metrics().set_gauge("prefill.sp_width", 1.0)
+            return
+        self._sp_failures = 0
+        with self._cv:
+            self._sp_prefills += 1
+        # The sp tier computed n full pages of prompt positions — the
+        # same prefill-work accounting as an in-tick chunk pass.
+        self._count_prefill(n * self._page)
+        if tracer.enabled:
+            tracer.add_span(
+                "batcher.sp_prefill",
+                start=t0,
+                end=tracer.now(),
+                request=req.req_id,
+                pages=n,
+                adopted=adopted,
+                sp=self._sp.sp,
+            )
+        if eo_on:
+            # span=False: batcher.sp_prefill above is the tracer row.
+            eo.phase("sp_prefill", t_ph, span=False)
+        global_flight_recorder().record(
+            "sp_prefill",
+            request=req.req_id,
+            pages=n,
+            adopted=adopted,
+            sp=self._sp.sp,
+        )
 
     # -- hierarchical KV cache tier (host-DRAM spill under the Pager) ------
 
@@ -2815,6 +2956,71 @@ class ContinuousBatcher:
             if n:
                 self._sentinel.rearm(prog, expect=n)
                 self._granted[prog] = self._granted.get(prog, 0) + n
+        # Sequence-parallel prefiller: its OWN mesh may have included
+        # the dead chip, and its tp must track the batcher's — rebuild
+        # the ring from survivors (width shrinks by powers of two),
+        # or degrade to the ordinary prefill path when no ring fits.
+        # The rebuilt instance's program variants are expected
+        # compiles: one allowance per bucket dispatched under the old
+        # epoch (the nvar rule — a prefiller that never ran banks
+        # nothing).
+        if self._sp_cfg is not None and self._sp_cfg.enabled:
+            cfg = self._sp_cfg
+            if self._sp is not None:
+                sp_variants = len(self._sp.variants)
+                sp_alive = [
+                    d for d in self._sp._mesh.devices.flat
+                    if int(d.id) not in dead
+                ]
+                self._sp.close()
+                self._sp = None
+            else:
+                # Breaker-retired earlier (consecutive dispatch
+                # failures — plausibly this very loss): rebuild from
+                # the platform pool minus the dead set.
+                sp_variants = 0
+                sp_alive = [
+                    d for d in jax.devices() if int(d.id) not in dead
+                ]
+            self._sp_failures = 0
+            w = cfg.sp_width
+            while w > 1 and w * new_tp > len(sp_alive):
+                w //= 2
+            if w > 1:
+                try:
+                    mesh_sp = build_sp_mesh(
+                        w, new_tp, cfg.sp_axis, axis, devices=sp_alive
+                    )
+                    self._sp = SPPrefiller(
+                        self.lm, self.variables, mesh_sp, self._page,
+                        kv_cache_dtype=self._kv_dtype,
+                        sp_axis=cfg.sp_axis,
+                        tp_axis=(axis if new_tp > 1 else None),
+                        name="batcher-sp",
+                    )
+                    if sp_variants:
+                        self._sentinel.rearm(
+                            "sp.prefill", expect=sp_variants
+                        )
+                        self._granted["sp.prefill"] = (
+                            self._granted.get("sp.prefill", 0)
+                            + sp_variants
+                        )
+                except Exception:  # noqa: BLE001 — degrade, don't wedge
+                    log.exception(
+                        "sp prefiller rebuild failed; sp prefill "
+                        "disabled until the next recovery"
+                    )
+            else:
+                log.warning(
+                    "sp prefill disabled: %d surviving ring devices "
+                    "support no sp >= 2 at tp=%d",
+                    len(sp_alive), new_tp,
+                )
+            global_metrics().set_gauge(
+                "prefill.sp_width",
+                float(self._sp.sp if self._sp is not None else 1),
+            )
         # Post-recovery dispatches repopulate against the new epoch —
         # a second recovery must size from its own epoch's variants
         # (the replay loop's _clear_slot dispatch is already one).
@@ -3395,6 +3601,12 @@ class ContinuousBatcher:
                 self._admitting = req.req_id  # cancel() sees it as live
             s0 = req.prompt.shape[0]
             bucket = next(b for b in self.prompt_buckets if b >= s0)
+            if self._sp is not None and s0 >= self._sp_cfg.sp_threshold:
+                # Long admission: sp-shard the prefill wall across the
+                # ring BEFORE the prefix probe — the probe then shares
+                # the landed pages as ordinary hits and the suffix
+                # pass is all that runs on the decode mesh.
+                self._sp_admit(req)
             m = 0
             if self._paged:
                 # Prefix probe: acquire (rc+1) every already-cached FULL
@@ -3458,14 +3670,26 @@ class ContinuousBatcher:
                 n_strip = m + sbucket // self._page
                 owned = self._pager.owned(i)
                 assert n_strip <= len(owned)
+                # Pad the window to a power-of-two page count (pad
+                # entries point at the trash page, masked past the
+                # causal window) — the SAME discipline as
+                # _prefill_step, so a long-context prompt's suffix
+                # pass compiles log2 window variants instead of one
+                # per prefix page count. Byte-equal by the pinned
+                # padding invariance (masked columns contribute exact
+                # zeros).
+                n_pad = 1
+                while n_pad < n_strip:
+                    n_pad *= 2
+                pages = owned[:n_strip] + [0] * (n_pad - n_strip)
                 ids = np.zeros((1, sbucket), np.int32)
                 ids[0, :slen] = req.prompt[m * self._page:]
                 first, first_lp, self._caches = self._prefill_suffix_fn(
-                    sbucket, n_strip
+                    sbucket, n_pad
                 )(
                     self.variables,
                     self._caches,
-                    self._h2d(np.asarray(owned[:n_strip], np.int32)),
+                    self._h2d(np.asarray(pages, np.int32)),
                     self._h2d(ids),
                     self._h2d(np.array(
                         [m * self._page, slen, req.top_k], np.int32
@@ -4162,6 +4386,14 @@ class ContinuousBatcher:
                 out["prefix_hits"] = ps.prefix_hits
                 out["prefix_misses"] = ps.prefix_misses
                 out["prefix_capacity_skips"] = ps.prefix_capacity_skips
+            if self._sp_cfg is not None:
+                # Sequence-parallel prefill books: the live ring width
+                # (1 = degraded to the ordinary path) and how many
+                # admissions took the sp program.
+                out["sp_width"] = (
+                    self._sp.sp if self._sp is not None else 1
+                )
+                out["sp_prefills"] = self._sp_prefills
             if self._tier is not None:
                 ts = self._tier.stats()
                 out["host_pages"] = ts.pages
@@ -4434,6 +4666,9 @@ class ContinuousBatcher:
         unregister_memory_source("continuous", self)
         unregister_roofline_source("continuous", self)
         _LIVE_BATCHERS.discard(self)
+        if self._sp is not None:
+            self._sp.close()
+            self._sp = None
         self._retired = True  # stop consuming membership events
         # Revoke this batcher's unconsumed recovery allowances: the
         # class-level watches outlive it, and leftover slack (a family

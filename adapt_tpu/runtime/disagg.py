@@ -90,8 +90,9 @@ from adapt_tpu.comm.framing import (
     frame_parts,
     parse_frame,
 )
-from adapt_tpu.config import DisaggConfig, SLOSpec
+from adapt_tpu.config import DisaggConfig, PrefillConfig, SLOSpec
 from adapt_tpu.models.transformer_lm import TransformerLM
+from adapt_tpu.parallel.sp_prefill import SPPrefiller, build_sp_mesh
 from adapt_tpu.runtime.continuous import ContinuousBatcher
 from adapt_tpu.runtime.paged import Pager
 from adapt_tpu.runtime.scheduler import QueueFullError
@@ -354,6 +355,10 @@ class _PrefillJob:
     target: int
     slot: int = -1
     pf_done: int = 0
+    #: Set when an sp dispatch failed and the job fell back to the
+    #: chunk path — the sp scan must not pick it up again (retrying a
+    #: deterministic failure forever would starve the queue).
+    no_sp: bool = False
 
 
 class PrefillWorker:
@@ -383,6 +388,8 @@ class PrefillWorker:
         prefill_chunk: int | None = None,
         kv_cache_dtype: str = "native",
         name: str = "prefill0",
+        prefill: PrefillConfig | None = None,
+        sp_mesh=None,
     ):
         if kv_cache_dtype not in ("native", "int8", "int4"):
             raise ValueError(
@@ -442,6 +449,38 @@ class PrefillWorker:
         self._fn_cache: dict[Any, Any] = {}
         self.prefill_tokens = 0
         self.handoffs = 0
+        # -- sequence-parallel long-context prefill ------------------------
+        # ``PrefillConfig{sp_threshold, sp_width}``: jobs of at least
+        # the threshold bypass the pool/chunk loop entirely — one
+        # sp-sharded whole-span program (``parallel/sp_prefill``)
+        # produces the handoff in a single :meth:`step` dispatch, and
+        # the prompt's O(S^2) attention splits over the ring instead of
+        # serializing on one chip. Failures fall back to the chunk path
+        # when the pool can cover the job, else fail the request
+        # cleanly through :attr:`failed_jobs` (drained by
+        # ``DisaggServer.tick``).
+        self._sp_cfg = prefill
+        self._sp: SPPrefiller | None = None
+        self.sp_prefills = 0
+        self.failed_jobs: list[tuple[int, str]] = []
+        if prefill is not None and prefill.enabled:
+            mesh = sp_mesh
+            if mesh is None:
+                mesh = build_sp_mesh(
+                    prefill.sp_width, 1, prefill.sp_axis
+                )
+            self._sp = SPPrefiller(
+                lm, variables, mesh, page_size,
+                kv_cache_dtype=kv_cache_dtype,
+                sp_axis=prefill.sp_axis,
+                tp_axis=(
+                    "tp" if "tp" in getattr(mesh, "shape", {}) else None
+                ),
+                name=f"{name}-sp",
+            )
+            global_metrics().set_gauge(
+                "prefill.sp_width", float(self._sp.sp)
+            )
         _LIVE_WORKERS.add(self)
         global_compile_sentinel().register(
             "disagg.prefill",
@@ -515,7 +554,9 @@ class PrefillWorker:
                 f"prompt of {s0} tokens has no full {self.page_size}-"
                 "token page to hand off"
             )
-        if m > self._pager.num_allocatable:
+        # sp-eligible jobs never touch the pool (the sp program holds
+        # the whole span sp-sharded), so the pool bound does not apply.
+        if m > self._pager.num_allocatable and not self.sp_eligible(s0):
             raise ValueError(
                 f"prompt needs {m} pages but the prefill pool holds "
                 f"{self._pager.num_allocatable}"
@@ -546,6 +587,74 @@ class PrefillWorker:
         """Jobs queued or mid-prefill."""
         return len(self._queue) + sum(
             1 for j in self._slots if j is not None
+        )
+
+    def sp_eligible(self, s0: int) -> bool:
+        """Whether a prompt of ``s0`` tokens takes the
+        sequence-parallel path (``PrefillConfig.sp_threshold``) —
+        also consulted by the placement policy, since sp jobs are
+        exempt from the pool-capacity bound."""
+        return (
+            self._sp is not None
+            and s0 >= self._sp_cfg.sp_threshold
+            and (s0 - 1) // self.page_size >= 1
+        )
+
+    def _sp_pass(self, job: _PrefillJob) -> KVHandoff | None:
+        """Run one sp-eligible job through the sp-sharded whole-span
+        program: the entire prefill in ONE dispatch, handoff built
+        straight from the program's page-major output — the job never
+        touches the pool. On failure: chunk-path fallback when the
+        pool can cover the job (front re-queue, FIFO restored), else
+        the request fails cleanly via :attr:`failed_jobs`."""
+        tracer = global_tracer()
+        t0 = tracer.now() if tracer.enabled else 0.0
+        try:
+            m, blocks = self._sp.prefill(job.prompt)
+        except Exception as e:  # noqa: BLE001 — degrade, never wedge
+            if job.target // self.page_size <= (
+                self._pager.num_allocatable
+            ):
+                log.exception(
+                    "sp prefill failed for request %d; falling back "
+                    "to the chunked path", job.req_id,
+                )
+                job.no_sp = True  # never re-picked by the sp scan
+                self._queue.appendleft(job)
+            else:
+                self.failed_jobs.append((job.req_id, str(e)[:200]))
+            return None
+        toks = m * self.page_size
+        self.prefill_tokens += toks
+        self.sp_prefills += 1
+        self.handoffs += 1
+        reg = global_metrics()
+        reg.inc("disagg.prefill_tokens_total", float(toks))
+        reg.inc("disagg.sp_prefills_total")
+        if tracer.enabled:
+            tracer.add_span(
+                "disagg.sp_prefill",
+                start=t0,
+                end=tracer.now(),
+                request=job.req_id,
+                pages=m,
+                sp=self._sp.sp,
+            )
+        global_flight_recorder().record(
+            "sp_prefill",
+            request=job.req_id,
+            pages=m,
+            sp=self._sp.sp,
+            tier="prefill",
+        )
+        return KVHandoff(
+            req_id=job.req_id,
+            prompt=job.prompt,
+            page_size=self.page_size,
+            n_pages=m,
+            quantized=self.quantized,
+            blocks=blocks,
+            kv_dtype=self.kv_cache_dtype,
         )
 
     def _admit(self) -> None:
@@ -616,11 +725,24 @@ class PrefillWorker:
         )
 
     def step(self) -> list[KVHandoff]:
-        """One prefill-tier scheduling round: admit waiting jobs, run
-        ONE chunk pass per active slot, hand off the finished ones.
+        """One prefill-tier scheduling round: dispatch at most ONE
+        sp-eligible job through the sequence-parallel program (its
+        whole span in one sp-sharded pass — the sp counterpart of the
+        chunk-pass stall bound), then admit waiting jobs, run ONE
+        chunk pass per active slot, and hand off the finished ones.
         Returns this round's completed handoffs (possibly empty)."""
-        self._admit()
         done: list[KVHandoff] = []
+        if self._sp is not None:
+            for i, job in enumerate(self._queue):
+                if not job.no_sp and self.sp_eligible(
+                    job.prompt.shape[0]
+                ):
+                    del self._queue[i]
+                    h = self._sp_pass(job)
+                    if h is not None:
+                        done.append(h)
+                    break  # one sp dispatch per step — the stall bound
+        self._admit()
         tracer = global_tracer()
         for job in list(self._slots):
             if job is None:
@@ -646,6 +768,8 @@ class PrefillWorker:
             "active": sum(1 for j in self._slots if j is not None),
             "prefill_tokens": self.prefill_tokens,
             "handoffs": self.handoffs,
+            "sp_prefills": self.sp_prefills,
+            "sp_width": self._sp.sp if self._sp is not None else 1,
             "pool_pages": ps.num_pages,
             "pages_in_use": ps.in_use,
         }
@@ -815,8 +939,13 @@ class DisaggServer:
         )
         if s0 < threshold:
             return False
-        if m > self.prefill._pager.num_allocatable:
-            return False  # the prefill pool can never cover it
+        if m > self.prefill._pager.num_allocatable and not (
+            self.prefill.sp_eligible(s0)
+        ):
+            # The prefill pool can never cover it — unless the tier's
+            # sequence-parallel path will take it (sp jobs hold their
+            # span sp-sharded in the program, never in the pool).
+            return False
         return self._prefill_alive()
 
     # -- request lifecycle -------------------------------------------------
@@ -1035,6 +1164,13 @@ class DisaggServer:
             )
         for handoff in self.prefill.step():
             self._land(handoff)
+        if self.prefill.failed_jobs:
+            # An sp job that could neither run nor fall back to the
+            # chunk path (pool too small for its span): fail the
+            # REQUEST cleanly, exactly like a corrupt handoff.
+            for sid, err in self.prefill.failed_jobs:
+                self._fail(sid, RuntimeError(err))
+            self.prefill.failed_jobs.clear()
         return self.decode.tick()
 
     def _busy(self) -> bool:
@@ -1137,6 +1273,13 @@ class DisaggServer:
             disaggregated=self.disaggregated,
             collocated_submits=self.collocated,
             handoff_failed=self.failed,
+            # Sequence-parallel tier books: the worker's sp-path
+            # dispatch count and live ring width (1 = sp off). A
+            # decode-side sp_prefills (collocated sp) would be
+            # clobbered here by design — a DisaggServer's sp work
+            # happens in the prefill tier.
+            sp_prefills=pf["sp_prefills"],
+            sp_width=pf["sp_width"],
         )
         # "queued" should reflect the whole server, or a driver's
         # drain loop would stop while the prefill tier still holds

@@ -55,20 +55,68 @@ PHASE_WALL_GUARD_S = 300.0
 def warmup(bat, vocab: int, steps_max: int, prompt_max: int) -> None:
     """Pre-pay the compile cost the first phase would otherwise eat as
     fake TTFT: one admission per prompt bucket the workload can hit
-    (prefill variants), with step counts covering the key-block
-    power-of-two buckets (``_stage_slot`` variants), then drain."""
+    (prefill variants — including the LONG-CONTEXT pow2 buckets: the
+    chunked-prefill window variants, the sp-prefill program + its
+    adopt-pages bucket and the pow2-padded suffix variant all compile
+    on that bucket's admission), with step counts covering the
+    key-block power-of-two buckets (``_stage_slot`` variants), then
+    drain.
+
+    The largest bucket is warmed too: a prompt of ``bucket`` tokens
+    leaves no decode room when ``bucket == max_len``, so the admission
+    shrinks to ``max_len - 1`` tokens while still mapping into that
+    bucket — previously the loop broke there and a first max-bucket
+    admission (a 32k prompt on a long-context config) paid its whole
+    compile stack mid-phase, measured as fake TTFT."""
     import numpy as np
 
     rng = np.random.RandomState(0)
     max_len = bat.lm.max_len
+    # Chunked-prefill batchers compile one FINAL-chunk variant per
+    # (last-chunk page class): a prompt's last pass runs cbucket =
+    # ceil((s0 mod chunk)/page)*page tokens, so lengths differing by a
+    # page can hit different variants. Warm every class per bucket by
+    # admitting page-stepped lengths, not just the bucket length.
+    chunk = getattr(bat, "_prefill_chunk", None)
+    page = getattr(bat, "_page", 0)
+    # Sequence-parallel batchers route warmup admissions >= the sp
+    # threshold through the sp program — which warms the sp/adopt/
+    # suffix families but leaves the threshold's bucket COLD for the
+    # chunked classes sub-threshold phase prompts hit. Warm those with
+    # page-stepped lengths just under the threshold too.
+    sp_cfg = getattr(bat, "_sp_cfg", None)
+    sp_thr = (
+        sp_cfg.sp_threshold
+        if sp_cfg is not None and getattr(bat, "_sp", None) is not None
+        else None
+    )
     # One admission per reachable prompt bucket (prefill variants).
     for bucket in bat.prompt_buckets:
-        n_steps = min(2, max_len - bucket)
-        if n_steps < 1:
-            break
-        bat.submit(
-            rng.randint(0, vocab, size=bucket).astype(np.int32), n_steps
-        )
+        plen = min(bucket, max_len - 1)
+        if next(b for b in bat.prompt_buckets if b >= plen) != bucket:
+            break  # shrunk length falls into an earlier bucket: done
+        lens = {plen}
+        if chunk and page:
+            for c in range(1, chunk // page):
+                shorter = plen - c * page
+                if shorter > 0 and next(
+                    b for b in bat.prompt_buckets if b >= shorter
+                ) == bucket:
+                    lens.add(shorter)
+        if sp_thr is not None and plen >= sp_thr:
+            steps_below = (chunk // page) if (chunk and page) else 1
+            for c in range(steps_below):
+                shorter = sp_thr - 1 - c * page
+                if shorter > 0 and next(
+                    b for b in bat.prompt_buckets if b >= shorter
+                ) == bucket:
+                    lens.add(shorter)
+        for length in sorted(lens):
+            n_steps = min(2, max_len - length)
+            bat.submit(
+                rng.randint(0, vocab, size=length).astype(np.int32),
+                n_steps,
+            )
         if bucket >= prompt_max:
             break  # later buckets are unreachable for this workload
     # Every key-block power-of-two bucket a step count in
@@ -108,6 +156,27 @@ def warmup_disagg(srv, vocab: int, steps_max: int,
     thr = min(real.prompt_threshold, real.busy_prompt_threshold)
     m_lo = max(1, (thr - 1) // P)
     m_hi = (prompt_max - 1) // P
+    # Which page counts to warm. The compiled families key on POWERS
+    # OF TWO (worker chunk windows, adopt-pages buckets, the
+    # pow2-padded decode-side suffix window) plus the worker's
+    # last-chunk remainder class (m mod chunk-pages), so a
+    # long-context config (m_hi in the hundreds) warms a pow2/pow2-1
+    # LADDER + a dense residue head instead of every page count — the
+    # per-m loop that was fine at 8 pages is 500 admissions at 64k
+    # tokens. Short configs keep the exact per-m loop.
+    if m_hi - m_lo <= 16:
+        ms = list(range(m_lo, m_hi + 1))
+    else:
+        cpp = max(1, (srv.prefill._chunk or P) // P)
+        picked = set(range(m_lo, min(m_lo + 2 * cpp, m_hi) + 1))
+        p2 = 1
+        while p2 <= m_hi:
+            for m in (p2 - 1, p2):
+                if m_lo <= m <= m_hi:
+                    picked.add(m)
+            p2 *= 2
+        picked.add(m_hi)
+        ms = sorted(picked)
     rng = np.random.RandomState(1)
     # Pin BOTH thresholds to the lower (busy) one for the warmup loop:
     # warmup runs at zero occupancy, where the real config would apply
@@ -116,7 +185,7 @@ def warmup_disagg(srv, vocab: int, steps_max: int,
     # mid-phase, the exact fake stall this function exists to prevent.
     srv.cfg = DisaggConfig(prompt_threshold=thr, busy_prompt_threshold=thr)
     try:
-        for m in range(m_lo, m_hi + 1):
+        for m in ms:
             # Smallest prompt with m full pages the policy will
             # actually disaggregate (at least the threshold).
             s0 = min(max(m * P + 1, thr), prompt_max)
@@ -181,7 +250,9 @@ def drive_phase(
     win = reg.snapshot(window=True)
     t0 = time.perf_counter()
     pi = 0
-    ticks0 = bat.stats()["ticks"]
+    stats0 = bat.stats()
+    ticks0 = stats0["ticks"]
+    sp0 = stats0.get("sp_prefills", 0)
     while True:
         now = time.perf_counter() - t0
         while pi < n and schedule[pi].t <= now:
@@ -317,6 +388,11 @@ def drive_phase(
         "request_ttfts": ttfts,
         "rejected_flags": rejected,
         "ticks": bat.stats()["ticks"] - ticks0,
+        # Sequence-parallel prefill books for the phase (0 on sp-off
+        # arms — the long_context A/B's structural check that the sp
+        # arm actually took the sp path).
+        "sp_prefills": bat.stats().get("sp_prefills", 0) - sp0,
+        "sp_width": bat.stats().get("sp_width", 1),
         "wall_s": round(wall_s, 3),
         "window_s": round(window_s, 3),
         "roofline": roofline,
@@ -356,6 +432,8 @@ def build_batcher(
     scheduler=None,
     pool_pages: int | None = None,
     cache_tier=None,
+    prefill=None,
+    prefill_chunk: int | None = None,
 ):
     """The harness's model+batcher factory (CPU-forced; tiny LM — the
     harness measures the serving tier's behavior under load, not model
@@ -363,7 +441,12 @@ def build_batcher(
     traffic-control tier on — the quota-on arm of an overload A/B.
     ``cache_tier`` (a ``config.CacheTierConfig``; paged only) turns
     the host-DRAM spill tier on — the tier-on arm of the corpus A/B —
-    and ``pool_pages`` pins the HBM budget so both arms run flat."""
+    and ``pool_pages`` pins the HBM budget so both arms run flat.
+    ``prefill`` (a ``config.PrefillConfig``; paged only) turns the
+    sequence-parallel long-context prefill path on — the sp-on arm of
+    the long_context A/B (the caller must provision
+    ``sp_width`` virtual devices first, e.g.
+    ``benchmarks.common.force_cpu_mesh``)."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
     import jax.numpy as jnp
@@ -383,6 +466,10 @@ def build_batcher(
         kw["cache_tier"] = cache_tier
     if scheduler is not None:
         kw["scheduler"] = scheduler
+    if prefill is not None and layout == "paged":
+        kw["prefill"] = prefill
+    if prefill_chunk is not None and layout == "paged":
+        kw["prefill_chunk"] = prefill_chunk
     return ContinuousBatcher(
         lm, variables, slots=slots, chunk=chunk, kv_layout=layout, **kw
     )
@@ -398,6 +485,7 @@ def build_disagg(
     prompt_threshold: int = 48,
     busy_prompt_threshold: int | None = None,
     scheduler=None,
+    prefill=None,
 ):
     """The disaggregated counterpart of :func:`build_batcher`: a paged
     decode batcher, a chunked ``PrefillWorker`` and the
@@ -417,6 +505,11 @@ def build_disagg(
         decode.variables,
         page_size=page_size,
         prefill_chunk=prefill_chunk or 2 * page_size,
+        # Sequence-parallel long-context jobs run sp-sharded in the
+        # TIER (`--sp on --placement disagg`): the worker's step()
+        # dispatches them through the sp program instead of the chunk
+        # loop, and prompts past the pool bound stay servable.
+        prefill=prefill,
     )
     # Default busy threshold: two pages, capped at the main threshold.
     # A/B drivers pass busy == prompt_threshold instead, which makes
@@ -463,6 +556,15 @@ def main() -> int:
     tier_arg = str_flag(
         sys.argv, "--cache-tier", "off", choices=("off", "on")
     )
+    # Sequence-parallel prefill: "on" routes prompts of at least
+    # --sp-threshold tokens through the sp-sharded prefill program at
+    # --sp-width ring ranks (implies --layout paged) — the sp-on arm
+    # of the long_context A/B, e.g.
+    # `--preset long_context --sp on` vs `--sp off`. Virtual CPU
+    # devices are provisioned automatically (force_cpu_mesh).
+    sp_arg = str_flag(sys.argv, "--sp", "off", choices=("off", "on"))
+    sp_width = int_flag(sys.argv, "--sp-width", 2)
+    sp_threshold = int_flag(sys.argv, "--sp-threshold", 4096)
     out = str_flag(sys.argv, "--out", "")
     try:
         rates = [float(r) for r in rates_arg.split(",") if r]
@@ -492,6 +594,17 @@ def main() -> int:
 
             cache_tier = CacheTierConfig()
             layout = "paged"
+        sp_cfg = None
+        if sp_arg == "on":
+            from benchmarks.common import force_cpu_mesh
+
+            from adapt_tpu.config import PrefillConfig
+
+            force_cpu_mesh(max(2, sp_width))
+            sp_cfg = PrefillConfig(
+                sp_threshold=sp_threshold, sp_width=sp_width
+            )
+            layout = "paged"
         if placement == "disagg":
             # Same schedule, disaggregated serving path (paged decode +
             # prefill tier) — the apples-to-apples arm of the
@@ -502,6 +615,7 @@ def main() -> int:
                 slots,
                 chunk,
                 scheduler=scheduler,
+                prefill=sp_cfg,
             )
         else:
             bat = build_batcher(
@@ -512,6 +626,7 @@ def main() -> int:
                 layout,
                 scheduler=scheduler,
                 cache_tier=cache_tier,
+                prefill=sp_cfg,
             )
         # Phase timing on: every curve point gets its roofline
         # annotation (mbu/mfu need measured phase walls).
@@ -537,6 +652,10 @@ def main() -> int:
             "layout": layout,
             "placement": placement,
             "scheduler": sched_arg,
+            "sp": sp_arg,
+            "prefill_cfg": (
+                dataclasses.asdict(sp_cfg) if sp_cfg else None
+            ),
             # Stamp the ACTIVE CacheTierConfig (capacity/codec/budgets)
             # so perf rows stay comparable across runs — a tier-on row
             # and a tier-off row are different serving configs.

@@ -175,6 +175,30 @@ PRESETS: dict[str, dict] = {
         ttft_budget_s=3.0,
         itl_budget_s=2.0,
     ),
+    # The LONG-CONTEXT preset: the long-document / agent-trace
+    # workload class ROADMAP item 5 names — lognormal 8k-64k prompts
+    # (median 16k, a heavy right tail capped at 64k) with SHORT
+    # outputs, so virtually all of the serving work is the prefill
+    # wall and TTFT is dominated by how fast one prompt's O(S^2)
+    # attention runs. This is the regime the sequence-parallel prefill
+    # path (`config.PrefillConfig{sp_threshold, sp_width}`,
+    # `harness.py --sp on|off`) exists for: one seeded schedule drives
+    # sp-on vs sp-off arms and the report carries TTFT percentiles
+    # for both. Offered rate is LOW by construction (long prompts are
+    # slow); budgets are prefill-scaled. benchmarks/load/sp_smoke.py
+    # runs a scaled-down instance of this shape as the CI arm.
+    "long_context": dict(
+        rate_rps=0.5,
+        duration_s=8.0,
+        prompt_median=16384,
+        prompt_sigma=0.7,
+        prompt_max=65536,
+        steps_median=16,
+        steps_sigma=0.4,
+        steps_max=32,
+        ttft_budget_s=60.0,
+        itl_budget_s=2.0,
+    ),
     "overload": dict(
         rate_rps=960.0,
         prompt_median=6,
