@@ -743,6 +743,39 @@ class ObservabilityConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class RuntimeConfig:
+    """Tick-runtime pipelining (``runtime/continuous.py`` "Pipelined
+    async runtime", docs/SERVING.md §3 "Async runtime").
+
+    ``pipeline_depth=1`` (the default) is the synchronous loop: each
+    ``tick()`` dispatches the decode/verify programs, blocks on the
+    one-fetch D2H, and commits the results before returning —
+    byte-for-byte the historical behavior. ``pipeline_depth=2``
+    overlaps host and device: while tick *t*'s programs execute on
+    device, the host runs tick *t+1*'s scheduler pass and fused
+    admission/staging, and tick *t*'s results commit one call LATER
+    (the one-tick commit lag — EOS/stop/cancel/SLO bookkeeping and
+    ``on_token`` delivery operate on tick *t−1*'s results while *t*
+    runs). Greedy streams stay bit-identical between depths; delivery
+    timing (TTFT/ITL stamps, cancel consumption) measures commit, not
+    device completion. Depths beyond 2 buy nothing on a
+    one-program-per-tick engine (the device queue is already full with
+    one tick in flight), so they are rejected eagerly rather than
+    silently behaving like 2."""
+
+    #: 1 = synchronous tick loop; 2 = one tick in flight (dispatch t
+    #: while committing t-1).
+    pipeline_depth: int = 1
+
+    def __post_init__(self):
+        if self.pipeline_depth not in (1, 2):
+            raise ValueError(
+                "pipeline_depth must be 1 (synchronous) or 2 "
+                f"(one tick in flight), got {self.pipeline_depth}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Top-level serving configuration."""
 
@@ -777,6 +810,9 @@ class ServeConfig:
     )
     prefill: PrefillConfig = dataclasses.field(
         default_factory=PrefillConfig
+    )
+    runtime: RuntimeConfig = dataclasses.field(
+        default_factory=RuntimeConfig
     )
     #: Hierarchical KV cache tier (None = off: evicted prefix pages
     #: die, today's behavior). Opt-in, unlike the sibling subsystem

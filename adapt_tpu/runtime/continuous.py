@@ -268,6 +268,7 @@ from adapt_tpu.config import (
     ParallelConfig,
     PrefillConfig,
     RecoveryConfig,
+    RuntimeConfig,
     SchedulerConfig,
     SLOSpec,
     SpeculativeConfig,
@@ -430,6 +431,97 @@ class _Slot:
     slo_ok: bool = True
 
 
+class _AsyncFetch:
+    """One tick's device→host result fetch with a ``.ready()`` /
+    ``.commit()`` split — the SHARED helper behind both the plain-tick
+    fetch and ``_spec_verify``'s ``(toks, lps, acc)`` fetch.
+
+    Construction starts the D2H copy immediately
+    (``copy_to_host_async`` on every leaf), so the transfer overlaps
+    whatever host work runs between dispatch and commit — in the
+    synchronous loop that is the tracer/phase bookkeeping (the old
+    path double-synced: dispatch enqueued the programs, then
+    ``jax.device_get`` started a cold blocking copy); in the pipelined
+    loop it is the WHOLE next tick's scheduler pass and dispatch.
+    ``commit()`` blocks until the copy lands and returns host numpy
+    arrays (cached — commit is idempotent); ``wait_s`` records how
+    long it actually blocked, which is the non-overlapped device wall
+    the ``runtime.overlap_ratio`` gauge is computed from."""
+
+    __slots__ = ("_arrays", "_host", "wait_s")
+
+    def __init__(self, arrays: tuple):
+        self._arrays = arrays
+        self._host: tuple | None = None
+        self.wait_s = 0.0
+        for a in arrays:
+            # Plain numpy (already host) has no async-copy hook.
+            start = getattr(a, "copy_to_host_async", None)
+            if start is not None:
+                start()
+
+    def ready(self) -> bool:
+        """True when every leaf's device computation + D2H copy has
+        completed — ``commit()`` would return without blocking."""
+        if self._host is not None:
+            return True
+        return all(
+            bool(getattr(a, "is_ready", lambda: True)())
+            for a in self._arrays
+        )
+
+    def commit(self) -> tuple:
+        """Block until the results land; return host numpy arrays."""
+        if self._host is None:
+            t0 = time.perf_counter()
+            self._host = tuple(
+                np.asarray(a) for a in jax.device_get(self._arrays)
+            )
+            self.wait_s = time.perf_counter() - t0
+            self._arrays = ()  # drop the device references
+        return self._host
+
+
+@dataclasses.dataclass
+class _InFlight:
+    """One dispatched-but-uncommitted decode tick (``pipeline_depth >=
+    2``; the synchronous loop builds one and commits it immediately).
+
+    ``reqs``/``lives`` capture per-slot BINDING IDENTITY at dispatch:
+    commit applies a slot's results only when the slot still holds the
+    same request object AND the same life (``slot.tokens`` list
+    identity — a preemption can release and re-admit the SAME request
+    object within the one-tick lag, and its fresh life must not
+    receive the old life's tick). Rows whose binding changed are
+    skipped: the tick decoded a bounded garbage tail for them (the
+    same < chunk-steps-per-retirement waste discipline mid-chunk
+    finishes already have)."""
+
+    fetch: _AsyncFetch
+    #: Per-slot request captured at dispatch (None = not in the decode
+    #: batch that tick) + the life marker (slot.tokens list identity).
+    reqs: list
+    lives: list
+    n_active: int = 0
+    #: Speculative-round metadata (None = lockstep chunk tick):
+    #: (draft_k_eff, tree_width, active slot indices) captured at
+    #: dispatch — set_draft_k may change the live values mid-lag.
+    spec: tuple | None = None
+    #: Tracer span start for decode_chunk/verify (0.0 = untraced at
+    #: dispatch) and EngineObs stamp for the decode/verify phase
+    #: (0.0 = obs_engine off at dispatch) — commit closes them only
+    #: when both ends were armed (the mid-flight-toggle guard).
+    t_span: float = 0.0
+    t_eo: float = 0.0
+    #: perf_counter at dispatch start / dispatch end. commit reads
+    #: them for runtime.overlap_ratio (1 - blocked-fetch-wait over the
+    #: dispatch-to-commit wall) and engine.phase.commit_lag_s.
+    t0: float = 0.0
+    t_dispatched: float = 0.0
+    #: Span tags captured at dispatch.
+    req_ids: tuple = ()
+
+
 class ContinuousBatcher:
     """Slot-based continuous batching over one LM — on one device, or
     tensor-parallel over a mesh's ``tp`` axis (``mesh=`` +
@@ -471,6 +563,7 @@ class ContinuousBatcher:
         cache_tier: CacheTierConfig | None = None,
         prefill: PrefillConfig | None = None,
         sp_mesh: Mesh | None = None,
+        runtime: RuntimeConfig | None = None,
     ):
         self.lm = lm
         # -- tensor parallelism (mesh-native serving) ----------------------
@@ -1018,6 +1111,17 @@ class ContinuousBatcher:
         #: this flag.
         self.obs_timeline = True
         self._itl_pending: list[float] = []
+        self._ttft_pending: list[float] = []
+        # -- pipelined tick runtime (config.RuntimeConfig) -----------------
+        # depth=1: tick() dispatches and commits synchronously (the
+        # historical loop, byte-identical scheduling). depth=2: tick()
+        # dispatches tick t, then commits tick t-1's _InFlight while t
+        # runs on device — one tick of results stays in flight between
+        # calls, drained at every pipeline boundary (run() exit,
+        # recover(), drain(), server-loop stop).
+        self._runtime = runtime or RuntimeConfig()
+        self._depth = self._runtime.pipeline_depth
+        self._inflight: _InFlight | None = None
         #: SLO accounting (docs/OBSERVABILITY.md "Workload telemetry").
         #: Hot path touches only these plain ints (one attribute inc
         #: per evaluated stamp); the registry sees them once per tick
@@ -2769,6 +2873,19 @@ class ContinuousBatcher:
         summary (also recorded as the ``mesh_reshard`` flight event).
         Raises :class:`DeviceLostError` when no recovery exists (all
         devices lost, or survivors below ``min_tp``)."""
+        # Pipeline boundary (RuntimeConfig.pipeline_depth >= 2): a
+        # dispatched-but-uncommitted tick drains BEFORE the mesh
+        # surgery below. Its results were computed on the old layout —
+        # under the simulated kill they are still readable, exactly
+        # like the last completed tick the synchronous loop commits
+        # before detecting the loss — and its commits move
+        # slot.tokens/emitted, which the migrate-vs-replay decisions
+        # and ``_replay_slot``'s delivered-token arithmetic read. This
+        # is where ``_lost_pending`` is consumed relative to the
+        # pipeline: at the tick boundary, never mid-flight.
+        fl, self._inflight = self._inflight, None
+        if fl is not None:
+            self._tick_commit(fl)
         t0 = time.perf_counter()
         # NOTE: _lost_pending is cleared only on success (or when there
         # is genuinely nothing to recover from) — a recovery that
@@ -3326,6 +3443,9 @@ class ContinuousBatcher:
         a handful of registry-lock holds, inside the obs budget
         (benchmarks/micro/obs_overhead.py)."""
         reg = global_metrics()
+        if self._ttft_pending:
+            reg.observe_many("continuous.ttft_s", self._ttft_pending)
+            self._ttft_pending = []
         if self._itl_pending:
             reg.observe_many("continuous.itl_s", self._itl_pending)
             self._itl_pending = []
@@ -3512,8 +3632,13 @@ class ContinuousBatcher:
             if slot.t_first == 0.0:
                 slot.t_first = now
                 if emitted_before == 0 and req.stream_skip == 0:
+                    # TTFT samples batch like ITL: one observe_many per
+                    # tick in _obs_flush. The budget COMPARISON stays
+                    # inline (plain float compare) — slo_ok must flip
+                    # before this tick's later goodput increments read
+                    # it.
                     ttft = now - req.t_submit
-                    global_metrics().observe("continuous.ttft_s", ttft)
+                    self._ttft_pending.append(ttft)
                     if req.slo is not None and (
                         req.slo.ttft_budget_s is not None
                     ):
@@ -3955,15 +4080,17 @@ class ContinuousBatcher:
                 self._stage_decode_row(slot)
 
     def _spec_decode(self, active, tracer):
-        """One SPECULATIVE decode round for the whole slot batch: the
-        fixed-shape draft scan (``models/speculative.draft_chunk`` over
-        the device-resident per-slot state), then the fused
-        verify-and-accept program (``_spec_verify``). Exactly two
-        compiled programs however rows desynchronize — guarded by the
-        compile-count test. Stages zero host arrays steady-state and
-        fetches the round's (tokens, logprobs, accepted) in ONE host
-        sync. Returns host-side ((d+1, B) tokens, logprobs, (B,)
-        per-slot commit limits)."""
+        """Dispatch one SPECULATIVE decode round for the whole slot
+        batch: the fixed-shape draft scan
+        (``models/speculative.draft_chunk`` over the device-resident
+        per-slot state), then the fused verify-and-accept program
+        (``_spec_verify``). Exactly two compiled programs however rows
+        desynchronize — guarded by the compile-count test. Stages zero
+        host arrays steady-state; the round's (tokens, logprobs,
+        accepted) D2H starts here as ONE async fetch and lands in
+        ``_tick_commit`` (same call at depth 1, next tick at depth 2).
+        Returns the round's :class:`_InFlight` (binding identity is
+        filled in by ``_tick_dispatch``)."""
         d = self._spec_k_eff
         w = self._spec_w
         self._variants.setdefault("speculative.draft_chunk", set()).add(d)
@@ -4036,65 +4163,84 @@ class ContinuousBatcher:
         with self._cv:
             self._ticks += 1
         global_metrics().inc("continuous.ticks")
-        # The round's ONE host sync fetches all three arrays together.
-        toks, lps, acc = jax.device_get((toks, lps, acc))
-        toks, lps, acc = np.asarray(toks), np.asarray(lps), np.asarray(acc)
-        if tracer.enabled:
-            tracer.add_span(
-                "decode.verify",
-                start=t_verify,
-                end=tracer.now(),
-                slots=len(active),
-                draft_k=d,
-                requests=req_ids,
-            )
-        if eo_on:
-            # Ends after the round's ONE fused host fetch (decode.verify
-            # is the tracer row for the same window).
-            eo.phase("verify", t_ph, span=False)
-        # Acceptance accounting: drafted/accepted proposals for the
-        # ACTIVE rows only (idle rows verify garbage nobody commits).
-        # Both counters move under _cv so a concurrent stats() snapshot
-        # cannot tear across them (the ADVICE-r4 rule the other
-        # lifetime counters follow).
-        acc_counts = [int(acc[s.idx]) for s in active]
-        with self._cv:
-            # Tree rounds draft d chain proposals + w leaf candidates
-            # per slot (acc counts a leaf hit as one more accepted).
-            self._spec_drafted += (d + w) * len(active)
-            self._spec_accepted += sum(acc_counts)
-            ratio = (
-                self._spec_accepted / self._spec_drafted
-                if self._spec_drafted
-                else 0.0
-            )
-        global_metrics().set_gauge("continuous.spec_acceptance", ratio)
-        if self.obs_timeline:
-            # One histogram sample per active slot per tick (one
-            # registry-lock hold, like the ITL flush).
-            global_metrics().observe_many(
-                "continuous.spec_accepted_per_tick",
-                [float(a) for a in acc_counts],
-            )
-        return toks, lps, acc + 1
+        # The round's ONE host fetch covers all three arrays — started
+        # here (async), landed at commit.
+        return _InFlight(
+            fetch=_AsyncFetch((toks, lps, acc)),
+            reqs=[],
+            lives=[],
+            spec=(d, w, tuple(s.idx for s in active)),
+            t_span=t_verify,
+            t_eo=t_ph,
+            req_ids=req_ids,
+        )
 
     def tick(self) -> int:
         """Admit waiting requests into free slots, run ONE prefill chunk
         for each slot mid-chunked-prefill, then decode: one chunk of
-        lockstep steps (a single compiled scan + one host sync) — or,
-        in speculative mode, one draft-scan + fused-verify round that
-        commits 1..draft_k+1 tokens per slot (``_spec_decode``).
-        Returns the number of active slots that consumed the decode
-        pass (0 = no decoding happened this tick).
+        lockstep steps (a single compiled scan) — or, in speculative
+        mode, one draft-scan + fused-verify round that commits
+        1..draft_k+1 tokens per slot (``_spec_decode``). Returns the
+        number of active slots whose decode pass was COMMITTED by this
+        call (0 = nothing committed).
+
+        The call is split into a host **dispatch** half
+        (``_tick_dispatch``: scheduler/admission/prefill + the decode
+        dispatch, with the D2H fetch started asynchronously) and a
+        **commit** half (``_tick_commit``: land the fetch, apply
+        per-slot commits, flush telemetry). At
+        ``RuntimeConfig.pipeline_depth=1`` the halves run back to back
+        — the historical synchronous loop, except the fetch now
+        overlaps the tracer/phase bookkeeping between them. At
+        ``depth=2`` this call dispatches tick *t* and then commits
+        tick *t−1* while *t* runs on device: the host's scheduler pass
+        overlaps the device wall, and every result is delivered with a
+        one-tick lag (drained at :meth:`drain` / :meth:`run` exit /
+        :meth:`recover`).
 
         Engine-tier phase timing (``utils.profiling.EngineObs``,
         ``obs_engine``): admit / prefill / draft / verify / decode /
-        commit / update each record one ``engine.phase.<name>_s``
-        histogram sample per tick when enabled; disabled, each site
-        costs one branch. The compile sentinel samples once at the end
-        of every tick, so an unexpected recompile is flagged next to
-        the tick that paid for it."""
+        dispatch / commit_lag / commit / update each record one
+        ``engine.phase.<name>_s`` histogram sample per tick when
+        enabled; disabled, each site costs one branch. decode/verify
+        span dispatch→results-landed, so under the pipelined loop they
+        OVERLAP the other phases — that overlap is the win, gauged as
+        ``runtime.overlap_ratio``. The compile sentinel samples once
+        at the end of every commit half, so an unexpected recompile is
+        flagged next to the tick that paid for it."""
+        if self._depth <= 1:
+            fl = self._tick_dispatch()
+            return self._tick_commit(fl) if fl is not None else 0
+        # Pipelined: dispatch t FIRST (its programs enqueue behind
+        # t-1's on the device stream), then commit t-1 on the host
+        # while t runs. _ensure_mesh inside the dispatch half drains
+        # the in-flight tick through recover() on a device loss.
+        fl = self._tick_dispatch()
+        prev, self._inflight = self._inflight, fl
+        if prev is not None:
+            return self._tick_commit(prev)
+        return 0
+
+    def drain(self) -> int:
+        """Commit the in-flight tick, if any (pipelined runtime) —
+        the explicit pipeline boundary. Call before reading results
+        outside :meth:`run` / :meth:`result`, before handing the
+        device to another dispatcher (DisaggServer does), or before
+        tearing down. Idempotent; returns the committed tick's active
+        count (0 = pipeline was empty)."""
+        fl, self._inflight = self._inflight, None
+        if fl is not None:
+            return self._tick_commit(fl)
+        return 0
+
+    def _tick_dispatch(self) -> "_InFlight | None":
+        """Host half of one tick: degradation/tier steps, admission,
+        cancel sweep, chunked-prefill passes, gauge refresh, then ONE
+        decode dispatch with its async D2H fetch started. Returns the
+        tick's :class:`_InFlight` record, or None for an idle tick
+        (nothing dispatched)."""
         self._ensure_mesh()
+        t0 = time.perf_counter()  # dispatch wall for overlap_ratio
         if self._controller is not None:
             # Closed-loop degradation BEFORE admission: this tick's
             # admits see the ladder's current shed level.
@@ -4191,13 +4337,12 @@ class ContinuousBatcher:
                 # decay toward zero).
                 self._obs_flush()
             self._sentinel.sample(write_gauges=False)
-            return 0
+            return None
         tracer = global_tracer()
         if self._spec is not None:
-            toks, lps, limits = self._spec_decode(active, tracer)
+            fl = self._spec_decode(active, tracer)
         else:
             t_ph = eo.now() if eo_on else 0.0
-            C = self.chunk
             # The whole per-slot staging block the old path rebuilt and
             # transferred here every tick (tokens/pos/keys/temps/top_ks/
             # top_ps/greedy — O(slots x fields) jnp.asarray calls) is
@@ -4223,30 +4368,143 @@ class ContinuousBatcher:
             with self._cv:
                 self._ticks += 1
             global_metrics().inc("continuous.ticks")
-            # The chunk's ONE host sync fetches both arrays together.
-            toks, lps = jax.device_get((toks, lps))
-            toks, lps = np.asarray(toks), np.asarray(lps)
-            limits = np.full((toks.shape[1],), C, np.int64)
-            if tracer.enabled:
-                # Dispatch + host sync of one compiled decode chunk —
-                # the Perfetto row that shows tick cadence and chunk
-                # cost.
+            # The chunk's ONE host fetch covers both arrays — started
+            # here (async), landed at commit.
+            fl = _InFlight(
+                fetch=_AsyncFetch((toks, lps)),
+                reqs=[],
+                lives=[],
+                t_span=t_chunk,
+                t_eo=t_ph,
+            )
+        # Binding identity for every slot in the decode batch: commit
+        # applies a slot's column only while it still holds the same
+        # request object AND the same life (slot.tokens list identity —
+        # see _InFlight). Captured AFTER the dispatch so a prefill-
+        # finishing slot that joined `active` this tick is included.
+        fl.reqs = [
+            s.req if (s.req is not None and s.pf_done < 0) else None
+            for s in self.slots
+        ]
+        fl.lives = [
+            s.tokens if fl.reqs[i] is not None else None
+            for i, s in enumerate(self.slots)
+        ]
+        fl.n_active = len(active)
+        fl.t0 = t0
+        if eo_on:
+            # Total host-side cost of this dispatch half — what the
+            # pipelined loop overlaps with the device wall.
+            eo.phase("dispatch", t0, span=False)
+        fl.t_dispatched = time.perf_counter()
+        return fl
+
+    def _tick_commit(self, fl: "_InFlight") -> int:
+        """Commit half of one tick: land ``fl``'s async fetch, close
+        the decode/verify spans it opened, apply per-slot token
+        commits (skipping slots whose binding changed since dispatch —
+        their columns are a bounded garbage tail nobody reads), then
+        window recycling, the telemetry flush, and the compile-
+        sentinel sample. Runs in the same :meth:`tick` call at depth
+        1; one tick later at depth 2."""
+        eo = self._eobs
+        eo_on = eo.enabled
+        if eo_on and fl.t_dispatched:
+            # Dispatch-end -> commit-entry: ~0 at depth 1; the NEXT
+            # tick's dispatch wall at depth 2 (the lag the stream
+            # timing docs describe).
+            eo.phase("commit_lag", fl.t_dispatched, span=False)
+        host = fl.fetch.commit()
+        tracer = global_tracer()
+        if fl.spec is None:
+            toks, lps = host
+            limits = np.full((toks.shape[1],), self.chunk, np.int64)
+            if tracer.enabled and fl.t_span:
+                # Dispatch -> results-landed of one compiled decode
+                # chunk — the Perfetto row that shows tick cadence and
+                # chunk cost (overlaps other rows under the pipelined
+                # loop).
                 tracer.add_span(
                     "batcher.decode_chunk",
-                    start=t_chunk,
+                    start=fl.t_span,
                     end=tracer.now(),
-                    slots=len(active),
-                    chunk=C,
+                    slots=fl.n_active,
+                    chunk=self.chunk,
                 )
-            if eo_on:
+            if eo_on and fl.t_eo:
                 # span=False: batcher.decode_chunk above is already the
                 # tracer row for this window.
-                eo.phase("decode", t_ph, span=False)
+                eo.phase("decode", fl.t_eo, span=False)
+        else:
+            toks, lps, acc = host
+            d, w, active_idx = fl.spec
+            if tracer.enabled and fl.t_span:
+                tracer.add_span(
+                    "decode.verify",
+                    start=fl.t_span,
+                    end=tracer.now(),
+                    slots=len(active_idx),
+                    draft_k=d,
+                    requests=fl.req_ids,
+                )
+            if eo_on and fl.t_eo:
+                # Ends when the round's ONE fused fetch lands
+                # (decode.verify is the tracer row for the same
+                # window).
+                eo.phase("verify", fl.t_eo, span=False)
+            # Acceptance accounting: drafted/accepted proposals for
+            # the rows ACTIVE at dispatch only (idle rows verify
+            # garbage nobody commits). Both counters move under _cv so
+            # a concurrent stats() snapshot cannot tear across them
+            # (the ADVICE-r4 rule the other lifetime counters follow).
+            # (d, w) come from the dispatch snapshot — set_draft_k
+            # mid-lag must not misattribute the round.
+            acc_counts = [int(acc[i]) for i in active_idx]
+            with self._cv:
+                # Tree rounds draft d chain proposals + w leaf
+                # candidates per slot (acc counts a leaf hit as one
+                # more accepted).
+                self._spec_drafted += (d + w) * len(active_idx)
+                self._spec_accepted += sum(acc_counts)
+                ratio = (
+                    self._spec_accepted / self._spec_drafted
+                    if self._spec_drafted
+                    else 0.0
+                )
+            global_metrics().set_gauge("continuous.spec_acceptance", ratio)
+            if self.obs_timeline:
+                # One histogram sample per active slot per tick (one
+                # registry-lock hold, like the ITL flush).
+                global_metrics().observe_many(
+                    "continuous.spec_accepted_per_tick",
+                    [float(a) for a in acc_counts],
+                )
+            limits = np.asarray(acc, np.int64) + 1
+        if fl.t0:
+            # Overlap gauge: the fraction of the dispatch->results
+            # wall the host did NOT spend blocked on the fetch. ~0 for
+            # a device-bound synchronous loop; -> 1 when the pipelined
+            # loop hides the device wall behind the next dispatch.
+            wall = time.perf_counter() - fl.t0
+            if wall > 0:
+                global_metrics().set_gauge(
+                    "runtime.overlap_ratio",
+                    max(0.0, 1.0 - fl.fetch.wait_s / wall),
+                )
         t_ph = eo.now() if eo_on else 0.0
         for i, slot in enumerate(self.slots):
-            if slot.req is None or slot.pf_done >= 0:
+            req = fl.reqs[i]
+            if req is None:
                 continue
-            req = slot.req
+            if (
+                slot.req is not req
+                or slot.tokens is not fl.lives[i]
+                or slot.pf_done >= 0
+            ):
+                # The binding moved since dispatch (retire + re-admit,
+                # preempt + replay — possible only under the one-tick
+                # lag): this column belongs to a dead life. Drop it.
+                continue
             # limits[i] is the slot's committable token count this tick:
             # the full chunk in lockstep mode, the accepted prefix + 1
             # correction token in speculative mode (rows desynchronize).
@@ -4290,7 +4548,7 @@ class ContinuousBatcher:
             # batched ITL flush, occupancy gauges.
             eo.phase("update", t_ph)
         self._sentinel.sample(write_gauges=False)
-        return len(active)
+        return fl.n_active
 
     def stats(self) -> dict:
         """Serving observability snapshot: slot occupancy, queue depth,
@@ -4309,6 +4567,12 @@ class ContinuousBatcher:
                 "admitted": self._admitted,
                 "completed": self._completed,
                 "ticks": self._ticks,
+                # Tick-runtime shape (config.RuntimeConfig): depth 1 =
+                # synchronous dispatch+commit; depth 2 = one tick in
+                # flight between calls (inflight reports whether one is
+                # pending right now).
+                "pipeline_depth": self._depth,
+                "inflight": self._inflight is not None,
                 # Prompt positions prefilled IN-TICK by this batcher
                 # (full/suffix/chunk passes; prefix-cache hits and
                 # disaggregated handoffs excluded) — pair with the
@@ -4576,6 +4840,11 @@ class ContinuousBatcher:
             ticks += 1
             if ticks > max_ticks:
                 raise RuntimeError(f"run() exceeded {max_ticks} ticks")
+        # Pipeline boundary: the loop exits when every slot RETIRED,
+        # which the pipelined runtime only does at commit — so any
+        # remaining in-flight tick is pure garbage tail. Drain it so
+        # the next caller (or a disagg handoff) sees an empty pipeline.
+        self.drain()
         done, self._done = self._done, {}
         return done
 
@@ -4605,7 +4874,7 @@ class ContinuousBatcher:
                     ):
                         self._cv.wait(timeout=0.1)
                     if self._stopping:
-                        return
+                        break
                 try:
                     self.tick()
                 except BaseException as e:  # noqa: BLE001 — re-raised
@@ -4621,6 +4890,19 @@ class ContinuousBatcher:
                     return
                 with self._cv:
                     self._cv.notify_all()  # results may have landed
+            # Stopping: drain the pipelined runtime's in-flight tick ON
+            # THE TICKING THREAD — stop() runs on the caller's thread
+            # and must not touch device state — so the last dispatched
+            # results commit before the thread exits and result()
+            # waiters wake to them.
+            try:
+                self.drain()
+            except BaseException as e:  # noqa: BLE001 — re-raised
+                with self._cv:
+                    self._server_error = e
+                log.error("drain on stop failed: %r", e)
+            with self._cv:
+                self._cv.notify_all()
 
         server = threading.Thread(
             target=loop, name="continuous-batcher", daemon=True
@@ -4666,6 +4948,7 @@ class ContinuousBatcher:
         unregister_memory_source("continuous", self)
         unregister_roofline_source("continuous", self)
         _LIVE_BATCHERS.discard(self)
+        self._inflight = None  # drop any undrained device references
         if self._sp is not None:
             self._sp.close()
             self._sp = None

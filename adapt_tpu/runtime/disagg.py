@@ -1142,7 +1142,17 @@ class DisaggServer:
 
     def tick(self) -> int:
         """One server scheduling round: prefill step -> land handoffs
-        -> decode tick. Returns the decode tick's active-slot count."""
+        -> decode tick. Returns the decode tick's active-slot count.
+
+        When the decode batcher runs the pipelined tick runtime
+        (``config.RuntimeConfig(pipeline_depth=2)``), its tick() here
+        dispatches round *t* and commits round *t−1* — the handoffs
+        landed above still enter admission on THIS call (admission is
+        dispatch-side), only result delivery lags one round. The
+        driver needs no pacing changes: :meth:`run`'s busy loop keys
+        off slot occupancy, which the batcher releases at commit, and
+        ``_collect`` drains the in-flight round explicitly before
+        claiming results."""
         if (
             self._registry is not None
             and not self._closed
@@ -1173,6 +1183,12 @@ class DisaggServer:
             self.prefill.failed_jobs.clear()
         return self.decode.tick()
 
+    def drain(self) -> int:
+        """Commit the decode tier's in-flight pipelined round, if any
+        (no-op at depth 1 / when idle) — the server-level pipeline
+        boundary drivers reach for between measurement phases."""
+        return self.decode.drain()
+
     def _busy(self) -> bool:
         if self.prefill.pending():
             return True
@@ -1192,6 +1208,10 @@ class DisaggServer:
         return self._collect()
 
     def _collect(self) -> dict[int, np.ndarray]:
+        # Pipeline boundary: commit any in-flight decode round before
+        # claiming results (run() below would also drain, but only
+        # after its occupancy check — be explicit at the handoff).
+        self.decode.drain()
         dec_done = self.decode.run(max_ticks=1)  # drained: returns dict
         out = dict(self._done)
         self._done = {}

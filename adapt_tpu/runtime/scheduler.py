@@ -445,7 +445,20 @@ class DegradationController:
 
     def step(self, bat) -> None:
         """One control evaluation (ticking thread, host arithmetic
-        only): escalate/de-escalate at most one rung per dwell."""
+        only): escalate/de-escalate at most one rung per dwell.
+
+        Staleness bound under the pipelined tick runtime
+        (``config.RuntimeConfig(pipeline_depth=2)``): this runs at the
+        top of the DISPATCH half, so the occupancy/attainment inputs
+        read here predate the in-flight tick's commit — slots that
+        tick retires still count occupied, and its SLO verdicts are
+        not yet in ``_slo_totals``. The error is bounded by exactly
+        ONE tick (at most ``chunk`` tokens per slot of pending
+        retirement, one tick of attainment movement), which is well
+        inside the controller's own ``degrade_dwell_s`` smoothing —
+        the ladder can react one tick late, never wrongly-direction.
+        Queue depth is exact (submissions are immediate, not
+        pipelined)."""
         cfg = self.cfg
         now = time.perf_counter()
         with bat._cv:

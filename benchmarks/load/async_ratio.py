@@ -1,0 +1,132 @@
+"""Async-vs-sync saturation A/B: the pipelined runtime must not lose.
+
+The same seeded saturating schedule (one rate, high enough that the
+batcher is the bottleneck, not the arrival process) runs through a
+synchronous batcher (``RuntimeConfig(pipeline_depth=1)``) and a
+pipelined one (depth 2) — fresh batcher per arm, each warmed, so jit
+caches and KV state never cross. The gated value is the throughput
+ratio async/sync: the pipelined loop overlaps tick *t+1*'s host
+scheduling with tick *t*'s device programs, so at saturation it must
+deliver AT LEAST the synchronous loop's tokens/s (>= 1.0 minus CI
+slack — the non-regression floor in benchmarks/baselines/seed.json,
+checked at unchanged SLO attainment).
+
+Determinism rides in extras: greedy streams are request-deterministic
+whatever the tick runtime, so both arms must finish with IDENTICAL
+per-request token counts (``token_counts_match``) — a mismatch means
+the one-tick commit lag leaked into results, which is a correctness
+bug, not a perf delta.
+
+One JSON line: value = async_throughput / sync_throughput;
+``vs_baseline`` = value − 1.0 (positive = pipelining ahead).
+
+Usage: ``python benchmarks/load/async_ratio.py [--seed 0]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks.common import emit, int_flag  # noqa: E402
+from benchmarks.load.workload import (  # noqa: E402
+    WorkloadSpec,
+    build_schedule,
+)
+
+#: Saturating offered rate, req/s: well past the tiny model's capacity,
+#: so both arms measure the tick loop's delivery rate, not the arrival
+#: process.
+RATE = 32.0
+UNIT = "async/sync throughput ratio at saturation"
+
+
+def main() -> int:
+    seed = int_flag(sys.argv, "--seed", 0)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import dataclasses
+
+        from benchmarks.load.harness import (
+            build_batcher,
+            drive_phase,
+            warmup,
+        )
+
+        from adapt_tpu.config import RuntimeConfig
+        from adapt_tpu.utils.profiling import global_engine_obs
+
+        spec = WorkloadSpec(
+            duration_s=2.0,
+            rate_rps=RATE,
+            prompt_median=6,
+            prompt_max=16,
+            steps_median=16,
+            steps_sigma=0.4,
+            steps_max=48,
+            ttft_budget_s=30.0,
+            itl_budget_s=10.0,
+        )
+        schedule = build_schedule(spec, seed)
+        global_engine_obs().enabled = True
+        reports = {}
+        for arm, depth in (("sync", 1), ("async", 2)):
+            bat = build_batcher(
+                spec.vocab, spec.prompt_max + spec.steps_max + 8,
+                slots=4, chunk=8,
+                runtime=RuntimeConfig(pipeline_depth=depth),
+            )
+            warmup(bat, spec.vocab, spec.steps_max, spec.prompt_max)
+            reports[arm] = drive_phase(
+                bat, schedule, dataclasses.replace(spec), registry=None
+            )
+            bat.close()
+        sync, asyn = reports["sync"], reports["async"]
+        if sync["throughput_tokens_s"] <= 0:
+            raise RuntimeError("sync arm delivered zero throughput")
+        ratio = asyn["throughput_tokens_s"] / sync["throughput_tokens_s"]
+        counts_match = sync["token_counts"] == asyn["token_counts"]
+        if not counts_match:
+            # A count divergence is a CORRECTNESS failure of the
+            # pipelined commit path, not a perf delta — fail loud.
+            raise RuntimeError(
+                "per-request token counts diverge between sync and "
+                "async arms (greedy streams must be runtime-invariant)"
+            )
+        emit(
+            "load_async_saturation_ratio",
+            round(ratio, 4),
+            UNIT,
+            round(ratio - 1.0, 4),
+            seed=seed,
+            rate_rps=RATE,
+            sync_throughput_tokens_s=sync["throughput_tokens_s"],
+            async_throughput_tokens_s=asyn["throughput_tokens_s"],
+            sync_slo_attainment=sync["slo_attainment"],
+            async_slo_attainment=asyn["slo_attainment"],
+            sync_ttft_p99_s=sync["ttft_s"].get("p99"),
+            async_ttft_p99_s=asyn["ttft_s"].get("p99"),
+            token_counts_match=counts_match,
+            tokens_delivered=asyn["tokens_delivered"],
+            sync_ticks=sync["ticks"],
+            async_ticks=asyn["ticks"],
+            schedule_digest=sync["schedule_digest"],
+        )
+    except Exception as e:  # noqa: BLE001 — always one JSON line, rc 0
+        print(
+            json.dumps(
+                {"metric": "load_async_saturation_ratio", "value": 0.0,
+                 "unit": UNIT, "vs_baseline": 0.0,
+                 "error": str(e)[-300:]}
+            ),
+            flush=True,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

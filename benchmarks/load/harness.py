@@ -288,6 +288,12 @@ def drive_phase(
             if gap > 0:
                 time.sleep(min(gap, 0.01))
         bat.tick()
+    # Pipelined runtimes (config.RuntimeConfig) may hold one garbage
+    # tick in flight after the last finish edge — drain it so the
+    # phase's windowed snapshot (and the next phase) start clean.
+    drain = getattr(bat, "drain", None)
+    if drain is not None:
+        drain()
     wall_s = time.perf_counter() - t0
 
     delta = reg.snapshot(since=win)
@@ -434,6 +440,7 @@ def build_batcher(
     cache_tier=None,
     prefill=None,
     prefill_chunk: int | None = None,
+    runtime=None,
 ):
     """The harness's model+batcher factory (CPU-forced; tiny LM — the
     harness measures the serving tier's behavior under load, not model
@@ -446,7 +453,9 @@ def build_batcher(
     sequence-parallel long-context prefill path on — the sp-on arm of
     the long_context A/B (the caller must provision
     ``sp_width`` virtual devices first, e.g.
-    ``benchmarks.common.force_cpu_mesh``)."""
+    ``benchmarks.common.force_cpu_mesh``). ``runtime`` (a
+    ``config.RuntimeConfig``) selects the tick runtime — depth 2 is
+    the pipelined/async arm of the runtime A/B."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
     import jax.numpy as jnp
@@ -470,6 +479,8 @@ def build_batcher(
         kw["prefill"] = prefill
     if prefill_chunk is not None and layout == "paged":
         kw["prefill_chunk"] = prefill_chunk
+    if runtime is not None:
+        kw["runtime"] = runtime
     return ContinuousBatcher(
         lm, variables, slots=slots, chunk=chunk, kv_layout=layout, **kw
     )
@@ -486,6 +497,7 @@ def build_disagg(
     busy_prompt_threshold: int | None = None,
     scheduler=None,
     prefill=None,
+    runtime=None,
 ):
     """The disaggregated counterpart of :func:`build_batcher`: a paged
     decode batcher, a chunked ``PrefillWorker`` and the
@@ -495,7 +507,7 @@ def build_disagg(
     defaults to two pages (the per-tick stall bound)."""
     decode = build_batcher(
         vocab, max_len, slots, chunk, layout="paged",
-        page_size=page_size, scheduler=scheduler,
+        page_size=page_size, scheduler=scheduler, runtime=runtime,
     )
     from adapt_tpu.config import DisaggConfig
     from adapt_tpu.runtime.disagg import DisaggServer, PrefillWorker
@@ -565,6 +577,14 @@ def main() -> int:
     sp_arg = str_flag(sys.argv, "--sp", "off", choices=("off", "on"))
     sp_width = int_flag(sys.argv, "--sp-width", 2)
     sp_threshold = int_flag(sys.argv, "--sp-threshold", 4096)
+    # Tick runtime: "async" runs the pipelined depth-2 runtime
+    # (config.RuntimeConfig(pipeline_depth=2) — host scheduling of
+    # tick t+1 overlaps tick t's device programs) so the SAME seeded
+    # schedule drives async-vs-sync arms, e.g. `--runtime async` vs
+    # `--runtime sync` (see load/async_ratio.py for the gated ratio).
+    runtime_arg = str_flag(
+        sys.argv, "--runtime", "sync", choices=("sync", "async")
+    )
     out = str_flag(sys.argv, "--out", "")
     try:
         rates = [float(r) for r in rates_arg.split(",") if r]
@@ -605,6 +625,11 @@ def main() -> int:
                 sp_threshold=sp_threshold, sp_width=sp_width
             )
             layout = "paged"
+        runtime = None
+        if runtime_arg == "async":
+            from adapt_tpu.config import RuntimeConfig
+
+            runtime = RuntimeConfig(pipeline_depth=2)
         if placement == "disagg":
             # Same schedule, disaggregated serving path (paged decode +
             # prefill tier) — the apples-to-apples arm of the
@@ -616,6 +641,7 @@ def main() -> int:
                 chunk,
                 scheduler=scheduler,
                 prefill=sp_cfg,
+                runtime=runtime,
             )
         else:
             bat = build_batcher(
@@ -627,6 +653,7 @@ def main() -> int:
                 scheduler=scheduler,
                 cache_tier=cache_tier,
                 prefill=sp_cfg,
+                runtime=runtime,
             )
         # Phase timing on: every curve point gets its roofline
         # annotation (mbu/mfu need measured phase walls).
@@ -653,6 +680,7 @@ def main() -> int:
             "placement": placement,
             "scheduler": sched_arg,
             "sp": sp_arg,
+            "runtime": runtime_arg,
             "prefill_cfg": (
                 dataclasses.asdict(sp_cfg) if sp_cfg else None
             ),

@@ -27,12 +27,17 @@ Four configurations over the SAME ContinuousBatcher steady state
   worker-side collect and the parent-side ingest of one report, i.e.
   both halves of the fleet path, timed inside the serving loop.
 
-TWO JSON lines: ``micro_obs_overhead_pct`` (fully-enabled "trace"
+THREE JSON lines: ``micro_obs_overhead_pct`` (fully-enabled "trace"
 overhead vs the floor, percent; ``vs_baseline`` = the 5% budget minus
-the measured overhead, positive = within budget) and
+the measured overhead, positive = within budget),
 ``micro_obs_federation_pct`` (federation config vs the same floor,
-same budget — gated via benchmarks/baselines/seed.json). Per-config
-per-tick means and the engine-only overhead ride in extras.
+same budget — gated via benchmarks/baselines/seed.json) and
+``micro_obs_overhead_async_pct`` (the same off-vs-trace delta measured
+on a SECOND batcher running the pipelined tick runtime,
+``RuntimeConfig(pipeline_depth=2)`` — the async loop moves the
+``_obs_flush``/SLO arithmetic onto the deferred commit half, and this
+row holds that seam to the SAME < 5% budget). Per-config per-tick
+means and the engine-only overhead ride in extras.
 
 Timing note (benchmarks/common.py): ticks end in a real host fetch of
 the chunk's tokens, so the region is honestly bounded per tick.
@@ -197,6 +202,66 @@ def main() -> int:
             .get("bench:obs0:%d" % os.getpid(), {})
             .get("reports", 0),
         )
+
+        # Async-runtime arm: off vs trace on a pipelined (depth-2)
+        # batcher. The deferred commit half carries the _obs_flush +
+        # SLO arithmetic there — same budget, measured separately so a
+        # regression on the deferred seam can't hide behind the sync
+        # numbers above. Same lm (its max_len covers this shorter
+        # plan); fresh batcher so jit caches and KV state don't cross.
+        from adapt_tpu.config import RuntimeConfig
+
+        bat.close()
+        abat = ContinuousBatcher(
+            lm, variables, slots=slots, chunk=chunk,
+            runtime=RuntimeConfig(pipeline_depth=2),
+        )
+        asteps = (n_ticks * (2 * trials + 1) + 8) * chunk
+        for _ in range(slots):
+            abat.submit(
+                rng.randint(0, 37, size=6).astype(np.int32), asteps,
+                slo=slo,
+            )
+        abat.tick()  # admission burst + this batcher's compiles
+        abat.tick()
+        for _ in range(n_ticks):  # warm before any timed window
+            abat.tick()
+        abest = {"off": float("inf"), "trace": float("inf")}
+        for t in range(trials):
+            order = (
+                ("off", "trace") if t % 2 == 0 else ("trace", "off")
+            )
+            for name in order:
+                on = name == "trace"
+                abat.obs_timeline = on
+                eobs.enabled = on
+                tracer.enabled = on
+                t0 = time.perf_counter()
+                for _ in range(n_ticks):
+                    abat.tick()
+                abest[name] = min(
+                    abest[name], (time.perf_counter() - t0) / n_ticks
+                )
+        tracer.enabled = False
+        eobs.enabled = False
+        if abat.stats()["active"] != slots:
+            raise RuntimeError(
+                "async batcher fell out of steady state mid-measure"
+            )
+        abat.close()
+        async_pct = (abest["trace"] / abest["off"] - 1.0) * 100.0
+        emit(
+            "micro_obs_overhead_async_pct",
+            async_pct,
+            "% tick wall time (trace vs off, pipelined depth-2 runtime)",
+            BUDGET_PCT - async_pct,
+            budget_pct=BUDGET_PCT,
+            tick_off_ms=round(abest["off"] * 1e3, 4),
+            tick_trace_ms=round(abest["trace"] * 1e3, 4),
+            slots=slots,
+            ticks=n_ticks,
+            trials=trials,
+        )
     except Exception as e:  # noqa: BLE001 — always one JSON line, rc 0
         emit(
             "micro_obs_overhead_pct", 0.0,
@@ -206,6 +271,12 @@ def main() -> int:
         emit(
             "micro_obs_federation_pct", 0.0,
             "% tick wall time (trace + telemetry report path vs off)",
+            0.0,
+            error=str(e)[-300:],
+        )
+        emit(
+            "micro_obs_overhead_async_pct", 0.0,
+            "% tick wall time (trace vs off, pipelined depth-2 runtime)",
             0.0,
             error=str(e)[-300:],
         )
