@@ -183,7 +183,12 @@ class SpeculativeConfig:
     scan over all slots plus ONE fused verify pass, and each slot
     commits its longest agreeing prefix plus the target's own
     correction token — between 1 and ``draft_k + 1`` tokens per tick
-    per slot, always exactly the target's greedy stream. The batcher
+    per slot. Greedy requests (temperature 0) get exactly the
+    target's argmax stream; sampled requests (temperature > 0) go
+    through SPECULATIVE SAMPLING — accept/reject each proposal
+    against the target distribution with residual resampling — so
+    the emitted distribution equals non-speculative sampling
+    (lossless in distribution, not bitwise). The batcher
     activates this mode when constructed with a draft model
     (``ContinuousBatcher(..., draft_lm=, draft_variables=,
     speculative=SpeculativeConfig(...))``).
@@ -611,6 +616,19 @@ class SchedulerConfig:
     degrade_attainment: float = 0.9
     #: Minimum dwell between ladder transitions (hysteresis).
     degrade_dwell_s: float = 0.25
+    #: Cache-aware admission ordering: among same-tenant, same-priority
+    #: queued requests, admit the one whose prompt has the
+    #: hottest/longest prefix RESIDENT in the pager's radix tree first
+    #: (``runtime/paged.Pager.radix_probe``). Arrival order only ever
+    #: re-orders within one tenant queue — priority classes, DRR
+    #: weights and burst caps are untouched — and only by a STRICT
+    #: score win, so a cold cache degrades to exact FIFO. Inert
+    #: without the paged KV layout.
+    cache_aware: bool = False
+    #: How many queue-head candidates the cache-aware pick scans per
+    #: pop (bounds both the probe cost per admission and how far a hot
+    #: request may jump the line).
+    cache_aware_window: int = 16
 
     def __post_init__(self):
         if self.max_queue_depth < 1:
@@ -643,6 +661,11 @@ class SchedulerConfig:
             raise ValueError(
                 f"degrade_dwell_s must be >= 0, got "
                 f"{self.degrade_dwell_s}"
+            )
+        if self.cache_aware_window < 1:
+            raise ValueError(
+                f"cache_aware_window must be >= 1, got "
+                f"{self.cache_aware_window}"
             )
 
 

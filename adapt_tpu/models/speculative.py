@@ -42,9 +42,17 @@ Scope of THIS module's loop: greedy (temperature 0 — where
 losslessness is exact equality), native-dtype caches, single-request.
 The batched composition lives in the continuous batcher's speculative
 mode — which also serves int8 KV caches (``verify_chunk`` /
-``verify_chunk_paged`` quantize their appends) and int8 draft WEIGHTS
+``verify_chunk_paged`` quantize their appends), int8 draft WEIGHTS
 (``SpeculativeConfig.draft_weight_dtype``; :func:`draft_chunk`
-dequantizes them in-program).
+dequantizes them in-program), and temperature > 0 requests via
+SPECULATIVE SAMPLING: the batcher's verify pass accepts each proposal
+with probability ``p_target(x) / p_draft(x)`` (here the draft proposes
+its argmax, so a proposal is accepted with the target's own
+probability of that token) and resamples rejections from the residual
+distribution — lossless in DISTRIBUTION rather than bitwise, the
+standard speculative-sampling guarantee. This module's loop stays
+greedy; the sampling correction lives in
+``runtime/continuous.ContinuousBatcher._spec_verify``.
 
 Numerics fine print: "exact equality" assumes the chunked verify and the
 sequential decode produce bitwise-equal logits. They run the same ops in

@@ -154,8 +154,14 @@ rounds — the same trash-slot/masked-write discipline as the rest of
 this module. Per-row greedy LOSSLESSNESS is the tested contract: each
 request's stream equals its solo ``generate()`` token-for-token
 whatever the draft proposes and however acceptance staggers across
-slots (speculative mode is greedy-only; ``submit`` rejects
-``temperature > 0``). The draft model keeps its own dense slot strips
+slots. ``temperature > 0`` requests are served via SPECULATIVE
+SAMPLING (the same verify pass, static ``sample`` flag): each
+proposal is accepted with the target's own probability of that token
+under the request's temperature/top-k/top-p processing and a
+rejection resamples from the residual distribution — provably the
+target's sampling distribution per position (lossless in
+DISTRIBUTION; greedy rows in the same batch still commit their exact
+argmax stream). The draft model keeps its own dense slot strips
 (it exists to be small — paging its cache buys capacity that is not
 the bottleneck) and is fully prefilled per admission; EOS/stop/cancel
 latch at acceptance boundaries through the ordinary commit path. The
@@ -397,6 +403,32 @@ class _Request:
     #: life must not re-enter goodput, re-fire ``slo_missed``, or
     #: finish with a ``met`` tenant verdict.
     slo_violated: bool = False
+    #: ``submit_fanout`` group id (-1 = ordinary request). Consumed at
+    #: admission (cleared there, so a pool-pressure re-queue or a
+    #: recovery replay never double-decrements the group).
+    fanout_group: int = -1
+
+
+@dataclasses.dataclass
+class _FanoutGroup:
+    """One :meth:`ContinuousBatcher.submit_fanout` group's shared
+    bookkeeping. ``remaining`` counts siblings not yet admitted (or
+    cancelled); the group dies when it reaches zero. For GREEDY groups
+    the first admitted sibling also records its last prompt page
+    (``page`` — rc-claimed via ``Pager.retain`` so it outlives that
+    sibling's retirement) and its first token/logprob: later siblings
+    whose prefix probe matches every earlier page take the
+    copy-on-write fork — one device page copy plus the cached first
+    commit — instead of recomputing the suffix forward. Sampled
+    groups leave ``page`` unset: each sibling needs fresh last-position
+    logits to draw its own first token from, so the suffix pass runs
+    anyway (the full prefix pages still share through the probe)."""
+
+    remaining: int
+    greedy: bool
+    page: int | None = None
+    first: int | None = None
+    first_lp: float | None = None
 
 
 @dataclasses.dataclass
@@ -795,7 +827,9 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"pool_pages must be >= 2, got {pool_pages}"
                 )
-            self._pager = Pager(pool_pages, slots, pps)
+            self._pager = Pager(
+                pool_pages, slots, pps, page_tokens=page_size
+            )
             self._pool_pages = pool_pages
 
             def one_cache():
@@ -861,7 +895,10 @@ class ContinuousBatcher:
         self._readmit_budget = (
             cache_tier.readmit_pages_per_tick if cache_tier else 0
         )
-        if self._tier is not None:
+        if self._paged:
+            # Always installed, tier or not: the hook records the
+            # radix_evict flight event for every cached-prefix death
+            # (spill/drop routing inside it stays tier-gated).
             self._pager.evict_hook = self._on_page_evict
         #: Instance-lifetime tier books (stats() mirrors of the
         #: cache_tier.* registry counters).
@@ -1060,6 +1097,23 @@ class ContinuousBatcher:
         #: preemption and the degradation controller.
         self._sched = scheduler
         self._queue: AdmissionQueue = AdmissionQueue(scheduler)
+        if (
+            self._paged
+            and scheduler is not None
+            and scheduler.cache_aware
+        ):
+            # Cache-aware admission ordering (SchedulerConfig
+            # .cache_aware): among one class's queued candidates, the
+            # queue prefers the request with the longest (then hottest)
+            # RESIDENT radix prefix — a read-only token walk over the
+            # pager's radix index, no rc movement, no page claims. The
+            # probe returns None on a cold prompt so a probe-less
+            # window stays byte-exact FIFO.
+            def _probe(r, _pager=self._pager):
+                pages, tokens, heat = _pager.radix_probe(r.prompt)
+                return (tokens, heat) if pages else None
+
+            self._queue.prefix_probe = _probe
         self._controller = (
             DegradationController(scheduler)
             if scheduler is not None and scheduler.degrade
@@ -1086,6 +1140,15 @@ class ContinuousBatcher:
         #: slot — the only window where a live request is in neither
         #: the queue nor a slot (cancel() must still see it as live).
         self._admitting: int | None = None
+        #: Copy-on-write fan-out (submit_fanout) books: group id ->
+        #: _FanoutGroup. Mutations are _cv-guarded (submit and cancel
+        #: run on client threads); pager claims only ever move on the
+        #: ticking thread — client-side group deaths park their claimed
+        #: page in ``_fanout_release``, drained at the next admission
+        #: sweep (the pager is not thread-safe).
+        self._fanout_groups: dict[int, _FanoutGroup] = {}
+        self._fanout_next = 0
+        self._fanout_release: list[int] = []
         self._next_id = 0
         self._prefill_cache: dict[int, Any] = {}  # bucket -> jitted fn
         # Instance-lifetime counts (stats() must not read the PROCESS
@@ -1171,6 +1234,11 @@ class ContinuousBatcher:
             # — dispatched only when a prefill tier streams pages in).
             self._sentinel.register(
                 "continuous.adopt_pages", type(self)._adopt_pages
+            )
+            # Copy-on-write fan-out fork (one variant ever: no static
+            # shape axis — dispatched only by submit_fanout siblings).
+            self._sentinel.register(
+                "continuous.fork_page", type(self)._fork_page
             )
         if self._spec:
             self._sentinel.register(
@@ -1538,14 +1606,30 @@ class ContinuousBatcher:
     @partial(
         jax.jit,
         static_argnums=(0,),
-        static_argnames=("epoch",),
+        static_argnames=("sample", "truncate", "nucleus", "epoch"),
         donate_argnums=(2, 3),
     )
     def _spec_verify(self, variables, caches, dstate, dtoks, table=None,
-                     cands=None, *, epoch=0):
+                     cands=None, *, sample=False, truncate=False,
+                     nucleus=False, epoch=0):
         """The speculative tick's VERIFY program — the second of its
         exactly two compiled programs (the first is the shared
         ``models/speculative.draft_chunk`` scan).
+
+        Static ``sample`` (with ``truncate``/``nucleus``, the
+        _step_chunk flag discipline) turns on SPECULATIVE SAMPLING for
+        ticks whose batch carries any ``temperature > 0`` row: each
+        proposal is accepted with the target's own probability of that
+        token under the row's processed distribution (the draft
+        proposes its argmax — a delta proposal, so ``min(1, p/q)``
+        reduces to ``p(token)``), a rejection resamples from the
+        RESIDUAL distribution (proposal mass removed), and the position
+        after a fully-accepted chain draws fresh — the standard
+        correction, provably the target's per-position sampling
+        distribution (lossless in DISTRIBUTION). Greedy rows in the
+        same batch keep their exact argmax stream via the final
+        select; all-greedy ticks compile ``sample=False``, whose
+        program text is unchanged from the greedy-only version.
 
         Builds every slot's (draft_k + 1) chunk ``[last_token,
         proposals]`` ON DEVICE from the draft scan's output, runs one
@@ -1629,6 +1713,82 @@ class ContinuousBatcher:
             logits.reshape(-1, logits.shape[-1]), preds.reshape(-1)
         ).reshape(preds.shape)  # (B, kc)
         acc = accept_speculation(props, preds[:, : d + 1])  # (B,)
+        if sample:
+            nd = d + 1
+            vocab = logits.shape[-1]
+            temps = dstate["temp"]
+            greedy = temps == 0.0
+            kbase, nkeys = dstate["kbase"], dstate["nkeys"]
+            # Key discipline matches _step_chunk: the token committed
+            # at stream offset j consumes the key at kbase + j (kbase
+            # advances by ncommit below). Each key splits once into an
+            # acceptance subkey and a resample subkey.
+            cursor = jnp.clip(
+                kbase[:, None] + jnp.arange(nd)[None, :], 0,
+                (nkeys - 1)[:, None],
+            )
+            skeys = jnp.take_along_axis(
+                dstate["keys"], cursor[:, :, None], axis=1
+            )  # (B, nd, 2)
+            subkeys = jax.vmap(jax.vmap(jax.random.split))(skeys)
+            k_acc, k_res = subkeys[:, :, 0, :], subkeys[:, :, 1, :]
+            lg = (
+                logits[:, :nd]
+                / jnp.maximum(temps, 1e-6)[:, None, None]
+            )
+            flat = lg.reshape(-1, vocab)
+            if truncate:
+                flat = self._truncate_rows(
+                    flat, jnp.repeat(dstate["top_k"], nd)
+                )
+            if nucleus:
+                flat = nucleus_filter(
+                    flat, jnp.repeat(dstate["top_p"], nd)
+                )
+            lgp = flat.reshape(lg.shape)  # processed logits (B, nd, V)
+            p_prop = jnp.take_along_axis(
+                jax.nn.log_softmax(lgp[:, :d], axis=-1),
+                props[:, :, None].astype(jnp.int32), axis=2,
+            )[..., 0]  # (B, d): log p_target(proposal_j)
+            u = jax.vmap(jax.vmap(jax.random.uniform))(k_acc)  # (B, nd)
+            ok = u[:, :d] < jnp.exp(p_prop)
+            cum = jnp.cumprod(ok.astype(jnp.int32), axis=1)  # (B, d)
+            acc_s = jnp.sum(cum, axis=1)
+            # Residual for chain rows: proposal mass removed (a
+            # proposal that is the only surviving token has p = 1, is
+            # always accepted, and its empty residual is never read).
+            # Row d has no proposal — a fresh full-distribution draw.
+            res = jnp.where(
+                jnp.arange(vocab)[None, None, :]
+                == props[:, :, None].astype(jnp.int32),
+                -jnp.inf, lgp[:, :d],
+            )
+            alt = jax.vmap(jax.vmap(jax.random.categorical))(
+                k_res, jnp.concatenate([res, lgp[:, d:]], axis=1)
+            ).astype(tok.dtype)  # (B, nd)
+            out_s = jnp.concatenate(
+                [
+                    jnp.where(
+                        cum.astype(bool), props.astype(tok.dtype),
+                        alt[:, :d],
+                    ),
+                    alt[:, d:],
+                ],
+                axis=1,
+            )  # (B, nd)
+            lps_s = chosen_logprob(
+                logits[:, :nd].reshape(-1, vocab), out_s.reshape(-1)
+            ).reshape(out_s.shape)  # raw-logit scoring, like _step_chunk
+            sel = greedy[:, None]
+            preds = jnp.concatenate(
+                [jnp.where(sel, preds[:, :nd], out_s), preds[:, nd:]],
+                axis=1,
+            )
+            lps = jnp.concatenate(
+                [jnp.where(sel, lps[:, :nd], lps_s), lps[:, nd:]],
+                axis=1,
+            )
+            acc = jnp.where(greedy, acc, acc_s)
         out_preds, out_lps = preds, lps
         if tree:
             # Bonus acceptance: full chain + correction token == a leaf
@@ -1637,6 +1797,11 @@ class ContinuousBatcher:
             corr = preds[:, d]  # target's token for position pos + d + 1
             match = cands.astype(corr.dtype) == corr[:, None]  # (B, w)
             hit = jnp.logical_and(acc == d, jnp.any(match, axis=1))
+            if sample:
+                # Sampled rows take no tree bonus: the leaf's cached
+                # K/V and the post-leaf prediction are argmax
+                # artifacts — committing them would bias the stream.
+                hit = jnp.logical_and(hit, greedy)
             s = jnp.argmax(match, axis=1)  # first matching leaf
             leaf_row = d + 1 + s
             bonus_tok = jnp.take_along_axis(
@@ -1737,6 +1902,35 @@ class ContinuousBatcher:
                 n_pair,
             )
             for c_pair, n_pair in zip(caches, kvs)
+        ]
+        return self._shard_kv(out)
+
+    @partial(
+        jax.jit,
+        static_argnums=(0,),
+        static_argnames=("epoch",),
+        donate_argnums=(1,),
+    )
+    def _fork_page(self, caches, srcdst, *, epoch=0):
+        """Copy-on-write fork: duplicate ONE physical page — every
+        block, both members of a quantized ``(values, scales)`` pair,
+        so the copy's scales travel with its int8 values — from
+        ``srcdst[0]`` into ``srcdst[1]``. The destination is a fan-out
+        sibling's freshly allocated private copy of its group's last
+        shared prompt page, taken at admission because the sibling's
+        decode is about to WRITE into that page (the eager moment of
+        "fork on first write": every sibling writes at its first
+        step). Pure pool gather/scatter, no forward pass; shard-local
+        under a head-sharded mesh (each device copies only its
+        resident heads). One compiled variant ever — there is no
+        static shape axis."""
+        caches = self._shard_kv(caches)
+        src, dst = srcdst[0], srcdst[1]
+        out = [
+            jax.tree.map(
+                lambda pool: pool.at[dst].set(pool[src]), c_pair
+            )
+            for c_pair in caches
         ]
         return self._shard_kv(out)
 
@@ -2002,10 +2196,18 @@ class ContinuousBatcher:
 
     def _on_page_evict(self, page: int, key: bytes) -> None:
         """``Pager.evict_hook``: a registered rc=0 page is leaving the
-        pool. Host-backed keys evict for free; otherwise spill inside
-        the per-tick budget, or count the content as dropped — the
-        watermark pre-spill in :meth:`_tier_step` exists to make this
-        branch rare."""
+        pool (its radix node dies with it — the pager already dropped
+        the key from the radix index). Every eviction records the
+        ``radix_evict`` flight event; with a host tier installed,
+        host-backed keys then evict for free while un-backed ones
+        spill inside the per-tick budget, or count the content as
+        dropped — the watermark pre-spill in :meth:`_tier_step` exists
+        to make this branch rare."""
+        global_flight_recorder().record(
+            "radix_evict",
+            page=int(page),
+            prefix_tokens=len(key) // 4,  # int32 token-block key
+        )
         tier = self._tier
         if tier is None:
             return
@@ -2383,16 +2585,12 @@ class ContinuousBatcher:
                 f"prompt {s0} exceeds largest bucket "
                 f"{self.prompt_buckets[-1]}"
             )
-        if self._spec and temperature > 0.0:
-            raise ValueError(
-                "speculative mode is greedy-only (v1): greedy is where "
-                "losslessness is exact equality — submit with "
-                "temperature=0, or serve sampled traffic through a "
-                "non-speculative batcher"
-            )
         if self._paged:
             bucket = next(b for b in self.prompt_buckets if b >= s0)
-            need = -(-max(bucket, s0 + steps + self._spec_k) // self._page)
+            need = -(
+                -max(bucket, s0 + steps + self._spec_k + self._spec_w)
+                // self._page
+            )
             if need > self._pool_pages - 1:  # page 0 is trash
                 # Would queue forever: the pool can never cover it.
                 raise ValueError(
@@ -2429,6 +2627,7 @@ class ContinuousBatcher:
         on_token: Callable[[int, int, int], None] | None = None,
         slo: SLOSpec | None = None,
         t_submit: float | None = None,
+        _fanout: int = -1,
     ) -> int:
         """Queue one request; returns its id. ``slo`` (optional
         ``config.SLOSpec``) attaches a latency budget: TTFT is judged
@@ -2525,6 +2724,7 @@ class ContinuousBatcher:
                 t_submit if t_submit is not None else time.perf_counter()
             ),
             slo=slo,
+            fanout_group=_fanout,
         )
         def _reject(e: QueueFullError, journaled: bool) -> None:
             self._record_rejection(
@@ -2586,6 +2786,125 @@ class ContinuousBatcher:
         global_metrics().inc("scheduler.admitted_total")
         return req.req_id
 
+    def submit_fanout(
+        self,
+        prompt,
+        n: int,
+        steps: int,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        eos_id: int | None = None,
+        rng: jax.Array | None = None,
+        stop: list | None = None,
+        on_token: Callable[[int, int, int], None] | None = None,
+        slo: SLOSpec | None = None,
+    ) -> list[int]:
+        """Queue ``n`` continuations of ONE prompt as a copy-on-write
+        fan-out group; returns their request ids in submission order.
+        Every sibling shares every common prompt page through the
+        prefix probe (rc bumps, no copies), and the group keeps the
+        first admitted sibling's last prompt page rc-claimed so later
+        siblings can FORK it — one device page copy, no suffix forward
+        — even after that sibling retired: fan-out of width N costs
+        ~1x the shared prefix's pages plus each sibling's private
+        decode tail. Greedy (``temperature == 0``) siblings are
+        bit-identical to ``n`` independent :meth:`submit` calls of the
+        same prompt. ``temperature > 0`` requires ``rng``; each
+        sibling samples under its own split of it (parallel sampling
+        semantics — the streams diverge by design, so sampled
+        siblings run the ordinary suffix pass for their own
+        first-token logits and share only the full prefix pages).
+        Dense layouts and ``n == 1`` degrade to plain serial submits.
+        On a mid-group :class:`QueueFullError` the already-queued
+        siblings STAY queued (their ids are lost with the raise — a
+        caller that must know them should submit serially); the group
+        shrinks to the survivors."""
+        if n < 1:
+            raise ValueError(f"fan-out width must be >= 1, got {n}")
+        sib_rngs: list = [None] * n
+        if temperature > 0.0:
+            if rng is None:
+                raise ValueError("temperature > 0 requires an rng key")
+            sib_rngs = list(jax.random.split(rng, n))
+        elif rng is not None:
+            sib_rngs = [rng] * n
+        gid = -1
+        if self._paged and n > 1:
+            with self._cv:
+                gid = self._fanout_next
+                self._fanout_next += 1
+                self._fanout_groups[gid] = _FanoutGroup(
+                    remaining=n, greedy=temperature == 0.0
+                )
+        ids: list[int] = []
+        try:
+            for j in range(n):
+                ids.append(
+                    self.submit(
+                        prompt,
+                        steps,
+                        temperature=temperature,
+                        top_k=top_k,
+                        top_p=top_p,
+                        eos_id=eos_id,
+                        rng=sib_rngs[j],
+                        stop=stop,
+                        on_token=on_token,
+                        slo=slo,
+                        _fanout=gid,
+                    )
+                )
+        except Exception:
+            # Shrink the group by the never-submitted siblings; a
+            # group emptied here dies on the CLIENT thread, so any
+            # claimed page parks for the ticking thread to release
+            # (the admitted-out death inside _admit releases directly).
+            if gid >= 0:
+                with self._cv:
+                    fg = self._fanout_groups.get(gid)
+                    if fg is not None:
+                        fg.remaining -= n - len(ids)
+                        if fg.remaining <= 0:
+                            self._fanout_kill_locked(gid, fg)
+            raise
+        return ids
+
+    def _fanout_kill_locked(
+        self, gid: int, fg: _FanoutGroup, direct: bool = False
+    ) -> None:
+        """Drop an emptied fan-out group (``_cv`` held). The claimed
+        page — if any — is released immediately when the caller IS the
+        ticking thread (``direct=True``: the admission path, so a
+        group that drains with its last sibling leaves no claim
+        dangling past the tick); client-thread deaths (queued-sibling
+        cancel, a failed submit_fanout) park it in ``_fanout_release``
+        instead — only the ticking thread may move pager rc — and the
+        next admission sweep drains the list."""
+        if fg.page is not None:
+            if direct:
+                self._pager.release_claim(fg.page)
+            else:
+                self._fanout_release.append(fg.page)
+            fg.page = None
+        self._fanout_groups.pop(gid, None)
+
+    def _fanout_dec_locked(self, req: "_Request") -> None:
+        """Consume ``req``'s fan-out membership (``_cv`` held): clear
+        the request's group id and shrink the group — admission and
+        queued-cancel both land here, so a pool-pressure re-queue
+        (group id already cleared) can never double-decrement."""
+        gid = req.fanout_group
+        if gid < 0:
+            return
+        req.fanout_group = -1
+        fg = self._fanout_groups.get(gid)
+        if fg is None:
+            return
+        fg.remaining -= 1
+        if fg.remaining <= 0:
+            self._fanout_kill_locked(gid, fg)
+
     def cancel(self, req_id: int) -> bool:
         """Cancel a request: queued -> dropped with an empty result;
         live (in a slot, or mid-admission on the ticking thread) ->
@@ -2606,6 +2925,10 @@ class ContinuousBatcher:
                 # while it was mid-admission before being re-queued
                 # on pool pressure) must not outlive it.
                 self._cancelled.discard(req_id)
+                # A cancelled fan-out sibling leaves its group; the
+                # last leaver kills the group (claimed page released
+                # on the ticking thread).
+                self._fanout_dec_locked(req)
                 # A freshly queued request delivered nothing, but a
                 # recovery-replayed one waiting for re-admission
                 # already streamed its first life's tokens: result()
@@ -2727,7 +3050,7 @@ class ContinuousBatcher:
             s0 = req.prompt.shape[0]
             bucket = next(b for b in self.prompt_buckets if b >= s0)
             need = -(
-                -max(bucket, s0 + req.steps + self._spec_k)
+                -max(bucket, s0 + req.steps + self._spec_k + self._spec_w)
                 // self._page
             )
             if self._pager.can_alloc(need):
@@ -2799,11 +3122,24 @@ class ContinuousBatcher:
         if k == self._spec_k_eff:
             return
         if k not in self._spec_k_granted:
-            for prog in (
-                "continuous.spec_verify", "speculative.draft_chunk"
-            ):
-                self._sentinel.rearm(prog, expect=1)
-                self._granted[prog] = self._granted.get(prog, 0) + 1
+            # One fresh draft variant per distinct k, plus one verify
+            # variant per sampling-flag combination already in service
+            # at this depth (greedy-only traffic has exactly one;
+            # sampled traffic adds its (sample, truncate, nucleus)
+            # combos — narrowing must stay lossless for them too).
+            combos = len({
+                v[1:] for v in self._variants.get(
+                    "continuous.spec_verify", set()
+                )
+            }) or 1
+            self._sentinel.rearm("continuous.spec_verify", expect=combos)
+            self._granted["continuous.spec_verify"] = (
+                self._granted.get("continuous.spec_verify", 0) + combos
+            )
+            self._sentinel.rearm("speculative.draft_chunk", expect=1)
+            self._granted["speculative.draft_chunk"] = (
+                self._granted.get("speculative.draft_chunk", 0) + 1
+            )
             self._spec_k_granted.add(k)
         self._spec_k_eff = k
         log.info("effective draft_k -> %d (configured %d)",
@@ -3053,6 +3389,9 @@ class ContinuousBatcher:
             # actually dispatched under the old epoch).
             expected["continuous.adopt_pages"] = nvar(
                 "continuous.adopt_pages"
+            )
+            expected["continuous.fork_page"] = nvar(
+                "continuous.fork_page"
             )
         if self._spec:
             # One re-lower per speculation DEPTH dispatched under the
@@ -3716,6 +4055,14 @@ class ContinuousBatcher:
         # headroom may free a slot here (replay-path preemption); the
         # loop below then admits it first (popleft is priority-first).
         self._maybe_preempt()
+        if self._paged:
+            # Drain page claims parked by client-thread fan-out group
+            # deaths (cancel / mid-group rejection): only this thread
+            # may move pager rc.
+            with self._cv:
+                rel, self._fanout_release = self._fanout_release, []
+            for pg in rel:
+                self._pager.release_claim(pg)
         for i, slot in enumerate(self.slots):
             if slot.req is not None:
                 continue
@@ -3724,6 +4071,7 @@ class ContinuousBatcher:
                     continue
                 req = self._queue.popleft()
                 self._admitting = req.req_id  # cancel() sees it as live
+                fg = self._fanout_groups.get(req.fanout_group)
             s0 = req.prompt.shape[0]
             bucket = next(b for b in self.prompt_buckets if b >= s0)
             if self._sp is not None and s0 >= self._sp_cfg.sp_threshold:
@@ -3769,8 +4117,28 @@ class ContinuousBatcher:
                         self._queue.appendleft(req)
                         self._admitting = None
                     return
+                # Radix books: token-weighted hit accounting for this
+                # admission (partial-hit counting when the match stops
+                # short of the last full prompt page).
+                self._pager.record_prefix_match(m, s0)
+            # Copy-on-write fork eligibility: a greedy fan-out sibling
+            # whose probe matched EVERY page before the last prompt
+            # token, with the group's source page claimed and its first
+            # commit cached — the suffix forward is skipped entirely
+            # (the source page already holds the K/V of every prompt
+            # position, the last one included).
+            cow = (
+                self._paged
+                and fg is not None
+                and fg.greedy
+                and fg.page is not None
+                and fg.first is not None
+                and req.temperature == 0.0
+                and m == (s0 - 1) // self._page
+            )
             chunked = (
                 self._paged
+                and not cow
                 and self._prefill_chunk is not None
                 and s0 - m * self._page > self._prefill_chunk
             )
@@ -3784,6 +4152,35 @@ class ContinuousBatcher:
                 # requests already decoding. The first token samples on
                 # the final chunk (no _commit here).
                 pass
+            elif cow:
+                # Copy-on-write fork: one device page copy (data-
+                # dependent on the source sibling's prefill through
+                # the donated cache buffers, so device-side ordering
+                # is free) plus the group's cached first commit below
+                # — zero prompt positions recomputed. Junk the source
+                # page may carry past the prompt (its owner's decode
+                # writes, when s0 is not page-aligned) is overwritten
+                # by this sibling's own first decode write or causally
+                # masked before any read, so the forked stream stays
+                # bit-identical to an independent submit's.
+                dst = self._pager.owned(i)[m]
+                self._variants.setdefault(
+                    "continuous.fork_page", set()
+                ).add(0)
+                self._caches = self._fork_page(
+                    self._caches,
+                    self._h2d(np.array([fg.page, dst], np.int32)),
+                    epoch=self._mesh_epoch,
+                )
+                self._pager.note_cow_fork()
+                global_flight_recorder().record(
+                    "cow_fork",
+                    request=req.req_id,
+                    src_page=int(fg.page),
+                    dst_page=int(dst),
+                    prefix_pages=m,
+                    saved_positions=s0 - m * self._page,
+                )
             elif m:
                 # Suffix-only prefill against the shared prefix pages.
                 # The suffix pads to whole PAGES, not prompt buckets —
@@ -3889,9 +4286,42 @@ class ContinuousBatcher:
             # missed — its client experienced the violation.
             slot.slo_ok = not req.slo_violated
             slot.pf_done = m * self._page if chunked else -1
+            tok0 = lp0 = None
+            if not chunked:
+                # One host sync per admission either way; the fork path
+                # reuses the group's cached first commit (greedy: the
+                # first token is a pure function of the prompt).
+                if cow:
+                    tok0, lp0 = fg.first, fg.first_lp
+                else:
+                    tok0, lp0 = int(first[0]), float(first_lp[0])
             with self._cv:
                 self._admitting = None  # slot-bound: visible to cancel()
                 self._admitted += 1
+                gid = req.fanout_group
+                if fg is not None and gid >= 0:
+                    req.fanout_group = -1
+                    fg.remaining -= 1
+                    if (
+                        fg.greedy
+                        and fg.page is None
+                        and fg.remaining > 0
+                        and tok0 is not None
+                    ):
+                        # First admitted greedy sibling: claim its
+                        # last prompt page (rc+1 — outlives the
+                        # sibling's retirement) and cache its first
+                        # commit for the siblings' forks. Chunked
+                        # admissions leave the group fork-less
+                        # (tok0 is None): later siblings run the
+                        # ordinary suffix path.
+                        fg.page = self._pager.owned(i)[
+                            (s0 - 1) // self._page
+                        ]
+                        self._pager.retain(fg.page)
+                        fg.first, fg.first_lp = tok0, lp0
+                    if fg.remaining <= 0:
+                        self._fanout_kill_locked(gid, fg, direct=True)
             global_metrics().inc("continuous.admitted")
             if self._paged:
                 # Prefix-cache effectiveness per admission: prompt pages
@@ -3920,7 +4350,7 @@ class ContinuousBatcher:
                 queue_wait_s=round(queue_wait, 6),
             )
             if not chunked:
-                self._commit(slot, int(first[0]), float(first_lp[0]))
+                self._commit(slot, tok0, lp0)
                 if slot.req is req:
                     # Survived the first commit: stage its whole device
                     # row in one fused setter call (and, speculating,
@@ -4093,8 +4523,21 @@ class ContinuousBatcher:
         filled in by ``_tick_dispatch``)."""
         d = self._spec_k_eff
         w = self._spec_w
+        # Static sampling flags, computed host-side exactly like the
+        # lockstep path's: an all-greedy batch keeps dispatching the
+        # PR-12 program text (bit-identity + compile footprint pinned);
+        # any sampled row switches the verify to its speculative-
+        # sampling variant, with the truncate/nucleus sorts elided
+        # unless some active request needs them.
+        sample = any(s.req.temperature > 0.0 for s in active)
+        truncate = sample and any(
+            s.req.top_k < self.lm.vocab for s in active
+        )
+        nucleus = sample and any(s.req.top_p < 1.0 for s in active)
         self._variants.setdefault("speculative.draft_chunk", set()).add(d)
-        self._variants.setdefault("continuous.spec_verify", set()).add(d)
+        self._variants.setdefault("continuous.spec_verify", set()).add(
+            (d, sample, truncate, nucleus)
+        )
         eo = self._eobs
         # Snapshot the gate ONCE per call: flipping obs_engine while a
         # tick is in flight must never pair a 0.0 open with an enabled
@@ -4158,6 +4601,9 @@ class ContinuousBatcher:
             dtoks,
             self._current_table() if self._paged else None,
             cands,
+            sample=sample,
+            truncate=truncate,
+            nucleus=nucleus,
             epoch=self._mesh_epoch,
         )
         with self._cv:
@@ -4650,6 +5096,17 @@ class ContinuousBatcher:
                 out["prefix_hits"] = ps.prefix_hits
                 out["prefix_misses"] = ps.prefix_misses
                 out["prefix_capacity_skips"] = ps.prefix_capacity_skips
+                # Radix prefix-cache books: resident token-block tree
+                # size, partial-hit admissions (match stopped short of
+                # the last full prompt page), token-weighted hit mass,
+                # and radix-node evictions.
+                out["radix_nodes"] = ps.radix_nodes
+                out["radix_partial_hits"] = ps.radix_partial_hits
+                out["radix_hit_tokens"] = ps.radix_hit_tokens
+                out["radix_evictions"] = self._pager.radix_evictions
+                # Copy-on-write fan-out books.
+                out["cow_forks"] = ps.cow_forks
+                out["fanout_groups"] = len(self._fanout_groups)
             if self._sp_cfg is not None:
                 # Sequence-parallel prefill books: the live ring width
                 # (1 = degraded to the ordinary path) and how many
@@ -4719,6 +5176,16 @@ class ContinuousBatcher:
             out["paged.prefix_capacity_skips"] = float(
                 ps.prefix_capacity_skips
             )
+            # Radix prefix cache + copy-on-write fan-out gauges
+            # (docs/OBSERVABILITY.md "Paged KV"): resident radix-tree
+            # size, partial-hit admissions, token-weighted hit mass,
+            # and the cumulative fork count (also an inc'd counter at
+            # the fork site — the gauge makes it scrape-visible even
+            # between exporter windows).
+            out["paged.radix_nodes"] = float(ps.radix_nodes)
+            out["paged.radix_partial_hits"] = float(ps.radix_partial_hits)
+            out["paged.radix_hit_tokens"] = float(ps.radix_hit_tokens)
+            out["paged.cow_forks_total"] = float(ps.cow_forks)
             if self._tier is not None:
                 # Host-tier occupancy: pages_spilled counts pages
                 # RESIDENT in host memory (warm + cold), host_bytes

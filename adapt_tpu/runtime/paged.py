@@ -17,6 +17,24 @@ same content keys shares them (rc+1) instead of recomputing —
 ``lookup_share`` / ``register``. Allocation evicts rc=0 cached pages
 only under pool pressure, oldest first.
 
+The registry doubles as a RADIX TREE over token blocks: every content
+key IS a root-to-node path (the key for page j is the byte string of
+tokens [0, (j+1)*P), so a key's parent is itself minus one page of
+tokens), which means the flat ``key -> page`` dict already encodes the
+trie — what ``_radix`` adds is the per-node metadata (block depth, hit
+heat) and the token-level accounting that makes PARTIAL matches
+first-class: an admission walks the deepest resident path and prefills
+only the suffix past it (a 900-token match on a 1000-token prompt
+recomputes 100 tokens), ``radix_probe`` scores a queued prompt's
+resident prefix without touching the books (the scheduler's
+cache-aware admission ordering reads it), and the
+``radix_partial_hits`` / ``radix_hit_tokens`` books say how much
+prefill the tree actually absorbed. Copy-on-write fan-out leans on the
+same refcounts: ``retain``/``release_claim`` let a fan-out group hold
+a raw claim on a shared page so N sibling continuations admit against
+it (rc bumps, no copies), and a sibling forks a private copy only for
+the one partial page it must write into (``cow_forks`` counts them).
+
 Conventions (shared with ``ops/paged_attention``):
 - page 0 is the shared TRASH page: never allocated, the target of every
   unallocated table entry and of idle slots' garbage writes. Reads of it
@@ -64,6 +82,22 @@ class PagerStats:
     prefix_hits: int
     prefix_misses: int
     prefix_capacity_skips: int  # resident page, but the table row was full
+    radix_nodes: int  # resident token-block nodes (== registered keys)
+    radix_partial_hits: int  # admissions whose match ended mid-path
+    radix_hit_tokens: int  # prompt tokens answered from resident nodes
+    cow_forks: int  # fan-out page forks (private copy of a shared page)
+
+
+@dataclasses.dataclass
+class _RadixNode:
+    """Metadata for one resident token-block node. The tree STRUCTURE
+    lives in the content keys themselves (a node's key is its full
+    root path; the parent key is the same bytes minus one page of
+    tokens), so nodes need no child pointers — only what a flat key
+    can't carry: the block depth and how hot the node runs."""
+
+    depth: int  # 1-based page depth (covers depth * page_tokens tokens)
+    hits: int = 0  # lookup_share acquisitions through this node
 
 
 class Pager:
@@ -72,7 +106,13 @@ class Pager:
     ``slots`` lockstep slots whose table rows are ``pages_per_slot``
     wide."""
 
-    def __init__(self, num_pages: int, slots: int, pages_per_slot: int):
+    def __init__(
+        self,
+        num_pages: int,
+        slots: int,
+        pages_per_slot: int,
+        page_tokens: int | None = None,
+    ):
         if num_pages < 2:
             raise ValueError(f"num_pages must be >= 2, got {num_pages}")
         if pages_per_slot < 1:
@@ -81,6 +121,11 @@ class Pager:
             )
         self.num_pages = num_pages
         self.pages_per_slot = pages_per_slot
+        #: Tokens per page — lets the radix books convert page depths
+        #: to token counts and ``radix_probe`` walk a raw prompt. None
+        #: (a caller that never probes by tokens) degrades the radix
+        #: view to depth-0 nodes with the byte registry untouched.
+        self.page_tokens = page_tokens
         # Pop from the end -> low page ids hand out first (determinism
         # helps test reproducibility; no perf meaning).
         self._free = list(range(num_pages - 1, 0, -1))
@@ -100,6 +145,16 @@ class Pager:
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.prefix_capacity_skips = 0
+        #: Radix metadata, keyed by the SAME content keys as _by_key
+        #: (kept in lockstep: inserted by register/adopt_cached, dropped
+        #: by the two eviction paths) — the byte registry stays the one
+        #: source of residency truth, radix coherence with the host
+        #: tier's spill/readmit keys is free.
+        self._radix: dict[bytes, _RadixNode] = {}
+        self.radix_partial_hits = 0
+        self.radix_hit_tokens = 0
+        self.radix_evictions = 0
+        self.cow_forks = 0
         #: Optional eviction callback ``(page, key) -> None``, invoked
         #: just BEFORE a registered rc=0 page leaves the pool (LRU
         #: eviction under allocation pressure, or an ``evict_cached``
@@ -129,10 +184,26 @@ class Pager:
             page, _ = self._lru.popitem(last=False)
             key = self._key_of.pop(page)
             del self._by_key[key]
+            self._radix_drop(key)
             if self.evict_hook is not None:
                 self.evict_hook(page, key)
             return page
         return None
+
+    # -- radix metadata (keys double as root-to-node paths) ----------------
+
+    def _radix_add(self, key: bytes) -> None:
+        if key not in self._radix:
+            depth = (
+                len(key) // (4 * self.page_tokens)
+                if self.page_tokens
+                else 0
+            )
+            self._radix[key] = _RadixNode(depth=depth)
+
+    def _radix_drop(self, key: bytes) -> None:
+        if self._radix.pop(key, None) is not None:
+            self.radix_evictions += 1
 
     def can_alloc(self, n: int) -> bool:
         return len(self._free) + len(self._lru) >= n
@@ -238,7 +309,68 @@ class Pager:
         self._rc[page] = self._rc.get(page, 0) + 1
         self._owned[slot].append(page)
         self.prefix_hits += 1
+        node = self._radix.get(key)
+        if node is not None:
+            node.hits += 1
         return page
+
+    def retain(self, page: int) -> None:
+        """Take one RAW claim on ``page`` (rc+1, out of the eviction
+        LRU) without binding it to a slot — how a fan-out group pins
+        its shared last-prompt page so it cannot recycle before every
+        queued sibling has forked off it. Balance with
+        :meth:`release_claim`."""
+        self._lru.pop(page, None)
+        self._rc[page] = self._rc.get(page, 0) + 1
+
+    def release_claim(self, page: int) -> None:
+        """Drop a :meth:`retain` claim; the usual rc=0 rules apply
+        (registered pages park in the LRU, others return free)."""
+        self._release_one(page)
+
+    def record_prefix_match(self, matched_pages: int, prompt_len: int) -> None:
+        """Token-weighted admission accounting for one radix walk:
+        ``matched_pages`` leading pages of a ``prompt_len``-token
+        prompt were answered from resident nodes. A match that ends
+        strictly inside the prompt's shareable page run is a PARTIAL
+        hit — the case whole-run keying would have scored as a total
+        miss."""
+        if matched_pages <= 0 or not self.page_tokens:
+            return
+        self.radix_hit_tokens += matched_pages * self.page_tokens
+        if matched_pages < (prompt_len - 1) // self.page_tokens:
+            self.radix_partial_hits += 1
+
+    def note_cow_fork(self) -> None:
+        """One fan-out sibling forked a private copy of a shared page
+        (the copy-on-write write point)."""
+        self.cow_forks += 1
+
+    def radix_probe(self, tokens) -> tuple[int, int, int]:
+        """Read-only radix walk for a prompt: ``(matched_pages,
+        matched_tokens, heat)`` of the deepest resident token-block
+        path, where ``heat`` sums the path nodes' lifetime hit counts.
+        No counters move and nothing is acquired — safe to call per
+        queued candidate (the scheduler's cache-aware ordering and
+        `prefix_cached` both score with it). The walk caps at
+        ``(len(tokens)-1)//page_tokens`` pages, mirroring the admission
+        probe: the page holding the last prompt token is never shared
+        because its tail positions get written."""
+        if not self.page_tokens:
+            return (0, 0, 0)
+        tokens = np.ascontiguousarray(
+            np.asarray(tokens, np.int32).reshape(-1)
+        )
+        raw = tokens.tobytes()
+        step = 4 * self.page_tokens
+        pages = heat = 0
+        for j in range((tokens.shape[0] - 1) // self.page_tokens):
+            node = self._radix.get(raw[: (j + 1) * step])
+            if node is None:
+                break
+            pages += 1
+            heat += node.hits
+        return (pages, pages * self.page_tokens, heat)
 
     def adopt_cached(self, keys: list[bytes]) -> list[tuple[int, int]]:
         """Adopt EXTERNALLY prefilled prefix pages into the cache — the
@@ -265,6 +397,7 @@ class Pager:
             page = self._take_one()
             self._by_key[key] = page
             self._key_of[page] = key
+            self._radix_add(key)
             self._lru[page] = None  # rc=0, resident, newest
             out.append((i, page))
         return out
@@ -284,6 +417,7 @@ class Pager:
             page, _ = self._lru.popitem(last=False)
             key = self._key_of.pop(page)
             del self._by_key[key]
+            self._radix_drop(key)
             if self.evict_hook is not None:
                 self.evict_hook(page, key)
             self._free.append(page)
@@ -312,6 +446,7 @@ class Pager:
             return
         self._by_key[key] = page
         self._key_of[page] = key
+        self._radix_add(key)
 
     def stats(self) -> PagerStats:
         # list(...) snapshots the dict at C speed: stats() is now also
@@ -327,6 +462,10 @@ class Pager:
             prefix_hits=self.prefix_hits,
             prefix_misses=self.prefix_misses,
             prefix_capacity_skips=self.prefix_capacity_skips,
+            radix_nodes=len(self._radix),
+            radix_partial_hits=self.radix_partial_hits,
+            radix_hit_tokens=self.radix_hit_tokens,
+            cow_forks=self.cow_forks,
         )
 
 

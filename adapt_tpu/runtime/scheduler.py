@@ -17,7 +17,14 @@ is the CONTROL half of the multi-tenant story (the MEASUREMENT half is
   global ``max_queue_depth`` and the per-tenant ``burst`` cap. With a
   single tenant and uniform priority the queue degrades to exactly the
   FIFO it replaces (same pop order, same head-of-line semantics), so a
-  scheduler-less batcher behaves as before — just bounded.
+  scheduler-less batcher behaves as before — just bounded. With
+  ``config.SchedulerConfig.cache_aware`` on, the pop additionally
+  scans a bounded window of the selected tenant queue and admits the
+  candidate whose prompt prefix is hottest/longest in the pager's
+  radix tree first (probe installed by the paged batcher) — priority
+  classes and DRR fairness are untouched; only same-tenant,
+  same-class arrival-order ties re-order, and only toward work whose
+  KV is already resident.
 - **Preemption** lives in ``runtime/continuous`` (it needs the slot
   machinery): when the queue's top class has waited past its TTFT
   headroom, the batcher preempts the lowest-priority decode slot via
@@ -138,6 +145,19 @@ class AdmissionQueue:
         self._tenant_depth: dict[str, int] = {}
         #: Degradation rung 4: reject ``priority < 0`` admits.
         self.shed_best_effort = False
+        #: Cache-aware pick (``SchedulerConfig.cache_aware``): the
+        #: batcher installs a callable ``req -> orderable score``
+        #: (radix-resident prefix length, heat) and ``_pick`` scans a
+        #: bounded window of the selected tenant queue for the hottest
+        #: candidate instead of taking the head. None -> strict FIFO
+        #: within the tenant queue, exactly the pre-radix behavior.
+        self.prefix_probe = None
+        #: req_ids re-inserted at the front (``appendleft``): pool-
+        #: pressure put-backs and preemption victims must keep strict
+        #: head-of-line service — the cache-aware scan is suppressed
+        #: while one waits, else a hotter newcomer could starve a
+        #: request the batcher already promised to retry next.
+        self._front: set[int] = set()
 
     # -- bounds ------------------------------------------------------------
 
@@ -219,6 +239,7 @@ class AdmissionQueue:
         — the head-of-line discipline FIFO mode gets for free."""
         tenant, prio = self._key(req)
         self._push(req, front=True)
+        self._front.add(req.req_id)
         if self._fifo:
             return
         ring = self._rings[prio]
@@ -275,7 +296,7 @@ class AdmissionQueue:
                     self._deficit[(prio, t)] = d
                     ring.rotate(-1)
                     continue
-            req = q.popleft()
+            req = self._pick(q)
             self._account_pop(t)
             d -= 1.0
             if not q:
@@ -296,6 +317,45 @@ class AdmissionQueue:
         self._rings.pop(prio, None)
         return None
 
+    def _pick(self, q):
+        """Take one request from tenant queue ``q``: strict FIFO head,
+        unless cache-aware ordering is on AND a probe is installed AND
+        the head is not a front re-insert — then scan the first
+        ``cache_aware_window`` entries and take the one with the
+        hottest/longest radix-resident prefix (STRICTLY greater score
+        wins, so equal-score candidates keep arrival order and a cold
+        queue degrades to exact FIFO). The window bounds the scan cost
+        per pop and the queue-jump distance: entry ``window`` onward
+        can be bypassed at most ``window - 1`` times per pop, so no
+        request waits unboundedly behind an endless hot stream."""
+        probe = self.prefix_probe
+        if (
+            probe is None
+            or not self.cfg.cache_aware
+            or len(q) < 2
+            or q[0].req_id in self._front
+        ):
+            req = q.popleft()
+        else:
+            n = min(len(q), max(1, self.cfg.cache_aware_window))
+            best, best_score = 0, None
+            for i in range(n):
+                try:
+                    score = probe(q[i])
+                except Exception:  # probe must never break admission
+                    score = None
+                if score is not None and (
+                    best_score is None or score > best_score
+                ):
+                    best, best_score = i, score
+            if best == 0:
+                req = q.popleft()
+            else:
+                req = q[best]
+                del q[best]
+        self._front.discard(req.req_id)
+        return req
+
     def remove_id(self, req_id: int):
         """Remove and return the queued request with ``req_id``
         (cancel path), or None."""
@@ -305,6 +365,7 @@ class AdmissionQueue:
                     if req.req_id == req_id:
                         del q[i]
                         self._account_pop(t)
+                        self._front.discard(req_id)
                         return req
         return None
 
@@ -312,6 +373,7 @@ class AdmissionQueue:
         self._classes.clear()
         self._rings.clear()
         self._deficit.clear()
+        self._front.clear()
         self._depth = 0
         for t in list(self._tenant_depth):
             if len(self._tenant_depth) > self._MAX_TENANTS:

@@ -25,6 +25,7 @@ Driver usage (one BENCH-style JSON line on stdout)::
     python benchmarks/load/harness.py --rates 4,8,16,32 --seed 0
     python benchmarks/load/harness.py --rates 8 --cancel-pct 50
     python benchmarks/load/harness.py --preset corpus --cache-tier on
+    python benchmarks/load/harness.py --preset agent_trace --fanout on
 """
 
 from __future__ import annotations
@@ -205,9 +206,18 @@ def drive_phase(
     spec: WorkloadSpec,
     registry=None,
     wall_guard_s: float = PHASE_WALL_GUARD_S,
+    fanout: bool = False,
 ) -> dict:
     """Run one phase to drain; returns the phase report (windowed
-    metrics + per-request token counts + digests)."""
+    metrics + per-request token counts + digests).
+
+    ``fanout=True`` (the ``--fanout on`` arm) submits each run of
+    consecutive same-``Arrival.group`` arrivals through ONE
+    ``submit_fanout`` call (copy-on-write page sharing across the
+    branches); ``fanout=False`` submits the identical schedule
+    serially — the two arms ``benchmarks/load/fanout_smoke.py``
+    compares. Ungrouped arrivals (``group == -1``) always submit
+    serially."""
     import numpy as np
 
     from adapt_tpu.config import SLOSpec
@@ -229,6 +239,10 @@ def drive_phase(
     rejected = [False] * n
     submit_wall = [0.0] * n
     ttfts: list[float | None] = [None] * n
+    #: Per-request emitted tokens, in commit order — the bit-identity
+    #: half of the determinism contract (A/B smokes compare these
+    #: between arms; the per-token append is trivial at bench scale).
+    streams: list[list[int]] = [[] for _ in range(n)]
 
     def make_cb(i: int, a: Arrival):
         def cb(rid, tok, idx, _i=i, _c=a.cancel_after):
@@ -238,6 +252,7 @@ def drive_phase(
                 # the overload gate needs, without growing registry
                 # cardinality per tenant.
                 ttfts[_i] = time.perf_counter() - submit_wall[_i]
+            streams[_i].append(int(tok))
             counts[_i] += 1
             if _c is not None and counts[_i] == _c:
                 # Token-space cancel mark: the marker is consumed
@@ -253,21 +268,65 @@ def drive_phase(
     stats0 = bat.stats()
     ticks0 = stats0["ticks"]
     sp0 = stats0.get("sp_prefills", 0)
+    cow0 = stats0.get("cow_forks", 0)
+    #: rid -> per-arrival callback for fan-out groups (one shared
+    #: on_token per group; siblings are told apart by request id).
+    #: Filled right after submit_fanout returns — safe because the
+    #: drive loop is single-threaded, so no tick (hence no token)
+    #: can land between the call and the map fill.
+    fan_cbs: dict[int, object] = {}
+
+    def fan_cb(rid, tok, idx):
+        cb = fan_cbs.get(rid)
+        if cb is not None:
+            cb(rid, tok, idx)
+
     while True:
         now = time.perf_counter() - t0
         while pi < n and schedule[pi].t <= now:
             a = schedule[pi]
+            slo = SLOSpec(
+                ttft_budget_s=spec.ttft_budget_s,
+                itl_budget_s=spec.itl_budget_s,
+                tenant=a.tenant,
+                priority=a.priority,
+            )
+            if fanout and a.group >= 0:
+                # One submit_fanout per run of same-group arrivals
+                # (build_schedule emits them contiguously at one t).
+                idxs = [pi]
+                while (
+                    pi + len(idxs) < n
+                    and schedule[pi + len(idxs)].group == a.group
+                ):
+                    idxs.append(pi + len(idxs))
+                wall = time.perf_counter()
+                for i in idxs:
+                    submit_wall[i] = wall
+                try:
+                    rids = bat.submit_fanout(
+                        np.asarray(a.prompt, np.int32),
+                        len(idxs),
+                        a.steps,
+                        slo=slo,
+                        on_token=fan_cb,
+                    )
+                    for rid, i in zip(rids, idxs):
+                        fan_cbs[rid] = make_cb(i, schedule[i])
+                except QueueFullError:
+                    # Mid-group raises lose the queued siblings' ids;
+                    # the fan-out arms run without a bounded queue, so
+                    # this is a whole-group reject in practice.
+                    for i in idxs:
+                        rejected[i] = True
+                pi += len(idxs)
+                continue
             submit_wall[pi] = time.perf_counter()
             try:
                 bat.submit(
                     np.asarray(a.prompt, np.int32),
                     a.steps,
-                    slo=SLOSpec(
-                        ttft_budget_s=spec.ttft_budget_s,
-                        itl_budget_s=spec.itl_budget_s,
-                        tenant=a.tenant,
-                        priority=a.priority,
-                    ),
+                    slo=slo,
                     on_token=make_cb(pi, a),
                 )
             except QueueFullError:
@@ -391,6 +450,7 @@ def drive_phase(
         "rejected": int(sum(rejected)),
         "tokens_delivered": int(sum(counts)),
         "token_counts": counts,
+        "token_streams": streams,
         "request_ttfts": ttfts,
         "rejected_flags": rejected,
         "ticks": bat.stats()["ticks"] - ticks0,
@@ -399,6 +459,10 @@ def drive_phase(
         # arm actually took the sp path).
         "sp_prefills": bat.stats().get("sp_prefills", 0) - sp0,
         "sp_width": bat.stats().get("sp_width", 1),
+        # Copy-on-write fork count for the phase (0 on --fanout off /
+        # dense arms — fanout_smoke's structural check that the fan-out
+        # arm actually shared pages instead of prefilling N times).
+        "cow_forks": bat.stats().get("cow_forks", 0) - cow0,
         "wall_s": round(wall_s, 3),
         "window_s": round(window_s, 3),
         "roofline": roofline,
@@ -413,6 +477,7 @@ def run_sweep(
     rates: list[float],
     seed: int,
     registry=None,
+    fanout: bool = False,
 ) -> list[dict]:
     """One phase per offered rate on ONE batcher (phase seeds derive
     from ``seed`` + the rate index, so every point is independently
@@ -421,7 +486,9 @@ def run_sweep(
     for i, rate in enumerate(rates):
         pspec = dataclasses.replace(spec, rate_rps=float(rate))
         schedule = build_schedule(pspec, seed + i)
-        report = drive_phase(bat, schedule, pspec, registry=registry)
+        report = drive_phase(
+            bat, schedule, pspec, registry=registry, fanout=fanout
+        )
         report["rate_rps"] = float(rate)
         report["seed"] = seed + i
         points.append(report)
@@ -574,6 +641,15 @@ def main() -> int:
     # of the long_context A/B, e.g.
     # `--preset long_context --sp on` vs `--sp off`. Virtual CPU
     # devices are provisioned automatically (force_cpu_mesh).
+    # Copy-on-write fan-out: "on" submits each same-group run of
+    # arrivals (the agent_trace preset's branches) through ONE
+    # submit_fanout call — shared prefix pages, CoW forks on
+    # divergence (implies --layout paged); "off" submits the identical
+    # schedule serially. `--preset agent_trace --fanout on` vs
+    # `--fanout off` is the pair benchmarks/load/fanout_smoke.py gates.
+    fanout_arg = str_flag(
+        sys.argv, "--fanout", "off", choices=("off", "on")
+    )
     sp_arg = str_flag(sys.argv, "--sp", "off", choices=("off", "on"))
     sp_width = int_flag(sys.argv, "--sp-width", 2)
     sp_threshold = int_flag(sys.argv, "--sp-threshold", 4096)
@@ -613,6 +689,8 @@ def main() -> int:
             from adapt_tpu.config import CacheTierConfig
 
             cache_tier = CacheTierConfig()
+            layout = "paged"
+        if fanout_arg == "on":
             layout = "paged"
         sp_cfg = None
         if sp_arg == "on":
@@ -665,7 +743,9 @@ def main() -> int:
             warmup_disagg(bat, spec.vocab, spec.steps_max, spec.prompt_max)
         else:
             warmup(bat, spec.vocab, spec.steps_max, spec.prompt_max)
-        points = run_sweep(bat, spec, rates, seed)
+        points = run_sweep(
+            bat, spec, rates, seed, fanout=fanout_arg == "on"
+        )
         peak = max(p["goodput_tokens_s"] for p in points)
         report = {
             "metric": "load_goodput_curve",
@@ -679,6 +759,7 @@ def main() -> int:
             "layout": layout,
             "placement": placement,
             "scheduler": sched_arg,
+            "fanout": fanout_arg,
             "sp": sp_arg,
             "runtime": runtime_arg,
             "prefill_cfg": (
@@ -694,8 +775,8 @@ def main() -> int:
             "spec": dataclasses.asdict(spec),
             "points": [
                 {k: v for k, v in p.items()
-                 if k not in ("token_counts", "request_ttfts",
-                              "rejected_flags")}
+                 if k not in ("token_counts", "token_streams",
+                              "request_ttfts", "rejected_flags")}
                 for p in points
             ],
         }

@@ -78,6 +78,24 @@ class WorkloadSpec:
     prefix_pool: int = 0
     prefix_len: int = 0
     prefix_skew: float = 0.8
+    #: Multi-turn conversations (the "multi_turn" preset): each base
+    #: arrival becomes a chain of ``turns`` requests, every follow-up
+    #: re-entering with the WHOLE conversation so far (previous prompt
+    #: + a seeded stand-in for the model's reply + a fresh user turn)
+    #: after ``turn_gap_s`` seconds — so the radix prefix cache sees
+    #: each conversation's hot node path again and again, at depths
+    #: whole-run keying cannot match (the re-entry is a PARTIAL hit:
+    #: old prompt pages resident, reply + new-turn pages fresh).
+    #: Chains stop early when the prompt would exceed ``prompt_max``.
+    turns: int = 1
+    turn_gap_s: float = 0.25
+    #: Agent-style branching (the "agent_trace" preset): each arrival
+    #: fans out into ``branches`` identical-prompt requests sharing an
+    #: ``Arrival.group`` id at the same instant — the shape
+    #: ``ContinuousBatcher.submit_fanout`` serves with copy-on-write
+    #: page sharing, and the ``harness.py --fanout`` arm drives
+    #: grouped-vs-serial over one schedule.
+    branches: int = 1
 
     def __post_init__(self):
         if self.arrival not in ("poisson", "deterministic"):
@@ -108,6 +126,16 @@ class WorkloadSpec:
             raise ValueError(
                 f"prefix_len {self.prefix_len} leaves no room for a "
                 f"tail under prompt_max {self.prompt_max}"
+            )
+        if self.turns < 1:
+            raise ValueError(f"turns must be >= 1, got {self.turns}")
+        if self.turns > 1 and self.turn_gap_s <= 0:
+            raise ValueError(
+                f"turn_gap_s must be > 0, got {self.turn_gap_s}"
+            )
+        if self.branches < 1:
+            raise ValueError(
+                f"branches must be >= 1, got {self.branches}"
             )
 
 
@@ -199,6 +227,47 @@ PRESETS: dict[str, dict] = {
         ttft_budget_s=60.0,
         itl_budget_s=2.0,
     ),
+    # The MULTI-TURN preset: short conversational opens that re-enter
+    # 3 more times each, every follow-up carrying the WHOLE
+    # conversation so far. Re-entries are the radix prefix cache's
+    # signature workload — the resident pages cover a strict PREFIX of
+    # the grown prompt (a partial hit whole-run content keys score as
+    # a miss), so token-weighted `paged.prefix_hits` under radix
+    # keying beats whole-run keying here by construction.
+    # benchmarks/micro/radix_prefix.py gates that gap in CI.
+    "multi_turn": dict(
+        rate_rps=12.0,
+        turns=4,
+        turn_gap_s=0.25,
+        prompt_median=6,
+        prompt_sigma=0.5,
+        prompt_max=96,
+        steps_median=6,
+        steps_sigma=0.4,
+        steps_max=12,
+        ttft_budget_s=3.0,
+        itl_budget_s=2.0,
+    ),
+    # The AGENT-TRACE preset: every arrival fans out into 4 branches
+    # with identical prompts at the same instant (tool-call / search
+    # style exploration), tied by `Arrival.group`. The harness's
+    # `--fanout on` arm submits each group via `submit_fanout` (width
+    # N costs ~1x the shared prefix pages, CoW forks on divergence);
+    # `--fanout off` submits the same schedule serially.
+    # benchmarks/load/fanout_smoke.py drives both arms and gates
+    # stream identity + the page-cost ratio.
+    "agent_trace": dict(
+        rate_rps=8.0,
+        branches=4,
+        prompt_median=12,
+        prompt_sigma=0.5,
+        prompt_max=96,
+        steps_median=6,
+        steps_sigma=0.4,
+        steps_max=12,
+        ttft_budget_s=3.0,
+        itl_budget_s=2.0,
+    ),
     "overload": dict(
         rate_rps=960.0,
         prompt_median=6,
@@ -258,6 +327,13 @@ class Arrival:
     cancel_after: int | None
     #: Scheduling class (rides ``SLOSpec.priority`` at submit).
     priority: int = 0
+    #: Fan-out group id: arrivals sharing a non-negative ``group``
+    #: carry identical prompts at the same instant (the "agent_trace"
+    #: preset's branch fan-out). The harness's ``--fanout on`` arm
+    #: submits each group through ``submit_fanout`` (copy-on-write
+    #: page sharing); ``--fanout off`` submits the same arrivals
+    #: serially. -1 = ordinary ungrouped request.
+    group: int = -1
 
 
 def _lognormal_len(
@@ -343,6 +419,54 @@ def build_schedule(spec: WorkloadSpec, seed: int) -> list[Arrival]:
                 priority=prio_map.get(tenant, 0),
             )
         )
+    if spec.turns > 1:
+        # Multi-turn chaining: every base arrival re-enters turns-1
+        # more times, each follow-up prompt = the whole conversation so
+        # far (previous prompt + a seeded stand-in for the model's
+        # reply, one token per decode step + a fresh user turn). The
+        # re-entry is exactly the radix cache's partial-hit shape: the
+        # old prompt's pages are resident, the reply/new-turn tokens
+        # are fresh. Chains stop early at prompt_max.
+        chained: list[Arrival] = []
+        for a in out:
+            chained.append(a)
+            prev = a
+            for _ in range(spec.turns - 1):
+                user_len = _lognormal_len(
+                    rng, spec.prompt_median, spec.prompt_sigma,
+                    spec.prompt_max,
+                )
+                prompt = prev.prompt + tuple(
+                    int(x) for x in rng.randint(
+                        0, spec.vocab, size=prev.steps + user_len
+                    )
+                )
+                if len(prompt) > spec.prompt_max:
+                    break
+                steps = _lognormal_len(
+                    rng, spec.steps_median, spec.steps_sigma,
+                    spec.steps_max,
+                )
+                prev = Arrival(
+                    t=prev.t + spec.turn_gap_s,
+                    prompt=prompt,
+                    steps=steps,
+                    tenant=a.tenant,
+                    cancel_after=None,
+                    priority=a.priority,
+                )
+                chained.append(prev)
+        chained.sort(key=lambda a: a.t)
+        out = chained
+    if spec.branches > 1:
+        # Branch fan-out: each arrival becomes `branches` siblings with
+        # identical prompts at the same instant, tied by a group id —
+        # the submit_fanout shape (shared prefix pages, CoW forks).
+        out = [
+            dataclasses.replace(a, group=gid)
+            for gid, a in enumerate(out)
+            for _ in range(spec.branches)
+        ]
     return out
 
 
@@ -354,7 +478,7 @@ def schedule_digest(schedule: list[Arrival]) -> str:
         h.update(
             repr(
                 (round(a.t, 9), a.prompt, a.steps, a.tenant,
-                 a.cancel_after, a.priority)
+                 a.cancel_after, a.priority, a.group)
             ).encode()
         )
     return h.hexdigest()[:16]

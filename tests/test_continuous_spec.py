@@ -272,12 +272,17 @@ def test_spec_tick_fixed_shape_zero_h2d_and_observability(
 def test_spec_validation(lm_setup, draft_setup):
     lm, variables = lm_setup
     draft, dvars = draft_setup
-    with pytest.raises(ValueError, match="greedy-only"):
-        bat = ContinuousBatcher(
-            lm, variables, slots=2, draft_lm=draft, draft_variables=dvars
-        )
-        bat.submit(np.asarray([1], np.int32), 2, temperature=0.7,
-                   rng=jax.random.PRNGKey(0))
+    # temperature>0 is SERVED speculatively now (speculative sampling,
+    # lossless in distribution) — the old greedy-only rejection was a
+    # synchronous submit-time ValueError, so its absence is checked at
+    # submit; the served streams themselves are covered end-to-end in
+    # test_radix_fanout.py (no need to pay a spec compile here).
+    bat = ContinuousBatcher(
+        lm, variables, slots=2, draft_lm=draft, draft_variables=dvars
+    )
+    rid = bat.submit(np.asarray([1], np.int32), 2, temperature=0.7,
+                     rng=jax.random.PRNGKey(0))
+    assert bat.cancel(rid)
     with pytest.raises(ValueError, match="draft_variables"):
         ContinuousBatcher(lm, variables, slots=2, draft_lm=draft)
     with pytest.raises(ValueError, match="requires draft_lm"):

@@ -21,6 +21,7 @@ Four layers, one file:
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import subprocess
@@ -53,6 +54,7 @@ from benchmarks.load.workload import (  # noqa: E402
     WorkloadSpec,
     build_schedule,
     offered_tokens,
+    preset,
     schedule_digest,
 )
 
@@ -298,6 +300,58 @@ def test_schedule_is_seed_deterministic_and_heavy_tailed():
     assert cancels and all(
         1 <= x.cancel_after < max(x.steps, 2) for x in cancels
     )
+
+
+def test_multi_turn_preset_chains_conversations():
+    """The multi_turn preset re-enters each conversation with the whole
+    history so far: every follow-up's prompt extends its predecessor's
+    prompt + reply (the radix cache's partial-hit shape), arrives
+    turn_gap_s later, keeps the tenant, and stays under prompt_max.
+    Chaining is seed-deterministic and digest-visible."""
+    spec = preset("multi_turn", duration_s=1.0)
+    assert spec.turns > 1
+    a = build_schedule(spec, seed=5)
+    assert a == build_schedule(spec, seed=5)
+    by_prompt = {x.prompt: x for x in a}
+    chained = 0
+    for x in a:
+        for upto in range(len(x.prompt) - 1, 0, -1):
+            prev = by_prompt.get(x.prompt[:upto])
+            if prev is not None and prev is not x:
+                assert len(x.prompt) >= len(prev.prompt) + prev.steps
+                assert x.tenant == prev.tenant
+                assert x.t >= prev.t + spec.turn_gap_s - 1e-9
+                chained += 1
+                break
+    assert chained >= len(a) // 3  # most arrivals are follow-ups
+    assert all(len(x.prompt) <= spec.prompt_max for x in a)
+    assert all(x.group == -1 for x in a)  # no branching in this preset
+    assert sorted(x.t for x in a) == [x.t for x in a]
+
+
+def test_agent_trace_preset_groups_branch_sets():
+    """The agent_trace preset fans every base arrival into `branches`
+    identical-prompt copies tied by a shared Arrival.group — the
+    submit_fanout unit the harness's --fanout arm consumes — and the
+    group ids land in the schedule digest."""
+    spec = preset("agent_trace", duration_s=1.0)
+    assert spec.branches > 1
+    a = build_schedule(spec, seed=5)
+    assert len(a) % spec.branches == 0
+    from collections import defaultdict
+
+    groups = defaultdict(list)
+    for x in a:
+        assert x.group >= 0
+        groups[x.group].append(x)
+    for g in groups.values():
+        assert len(g) == spec.branches
+        assert len({(x.prompt, x.steps, x.t, x.tenant) for x in g}) == 1
+    # group ids are digest-relevant: branch-width changes re-key runs.
+    b = build_schedule(
+        dataclasses.replace(spec, branches=2), seed=5
+    )
+    assert schedule_digest(a) != schedule_digest(b)
 
 
 def test_drive_phase_token_counts_deterministic(clean_slate, batcher_factory):

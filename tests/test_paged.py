@@ -48,6 +48,49 @@ def test_pager_validation():
         p.alloc(0, 3)
 
 
+def test_pager_radix_probe_and_books():
+    """``radix_probe`` walks the deepest resident token-block path
+    READ-ONLY (no counters move, nothing acquired),
+    ``record_prefix_match`` books token-weighted hits and flags
+    matches ending strictly inside the prompt's shareable run as
+    partial, ``lookup_share`` heat feeds back into the probe, and
+    eviction prunes the radix in lockstep with the byte registry."""
+    P = 4
+    p = Pager(num_pages=8, slots=2, pages_per_slot=4, page_tokens=P)
+    toks = np.arange(12, dtype=np.int32)
+    assert p.alloc(0, 2)
+    pages = p.owned(0)
+    for j, page in enumerate(pages):
+        p.register(page, Pager.prefix_key(toks, (j + 1) * P))
+    assert p.stats().radix_nodes == 2
+    # The walk caps at (len-1)//P pages: the last-token page is never
+    # shareable, so a 12-token prompt matches at most 2 pages.
+    assert p.radix_probe(toks) == (2, 8, 0)
+    longer = np.concatenate([toks, np.arange(6, dtype=np.int32)])
+    assert p.radix_probe(longer)[:2] == (2, 8)  # shared-prefix match
+    assert p.radix_probe(np.ones(12, np.int32))[0] == 0  # diverges
+    st = p.stats()
+    assert (st.prefix_hits, st.radix_hit_tokens) == (0, 0)  # read-only
+    # Full-cap match on the 12-token prompt: a hit, NOT partial.
+    p.record_prefix_match(2, 12)
+    st = p.stats()
+    assert (st.radix_hit_tokens, st.radix_partial_hits) == (8, 0)
+    # The same 2 pages against the longer prompt end strictly inside
+    # its shareable run — the case whole-run keying scores as a miss.
+    p.record_prefix_match(2, len(longer))
+    st = p.stats()
+    assert (st.radix_hit_tokens, st.radix_partial_hits) == (16, 1)
+    # Heat: lookup_share bumps the node, the probe sums the path.
+    p.free_slot(0)  # registered pages park rc=0 in the LRU
+    assert p.lookup_share(1, Pager.prefix_key(toks, P)) == pages[0]
+    assert p.radix_probe(toks)[2] == 1
+    # Eviction drops radix nodes with their keys and counts it.
+    p.free_slot(1)
+    assert p.evict_cached() == 2
+    assert p.stats().radix_nodes == 0 and p.radix_evictions == 2
+    assert p.radix_probe(toks) == (0, 0, 0)
+
+
 # -- kernel vs oracle --------------------------------------------------------
 
 

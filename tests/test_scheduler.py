@@ -213,6 +213,92 @@ def test_queue_remove_id_depths_and_rebuild():
     assert sorted(r.req_id for r in q) == [0, 2, 3]
 
 
+def test_queue_cache_aware_picks_hottest_prefix_in_window():
+    """``cache_aware=True`` with an installed probe: the pop takes the
+    hottest/longest radix-resident prefix among the first
+    ``cache_aware_window`` candidates of the selected tenant queue —
+    entries past the window cannot jump, equal scores keep strict
+    arrival order (a cold queue degrades to byte-exact FIFO), and a
+    probe that explodes must never break admission."""
+    cfg = SchedulerConfig(cache_aware=True, cache_aware_window=3)
+    q = AdmissionQueue(cfg)
+    score = {0: (0, 0), 1: (2, 5), 2: (2, 9), 3: (0, 0), 4: (9, 9)}
+    q.prefix_probe = lambda r: score[r.req_id]
+    for i in range(5):
+        q.append(_Req(i, "t"))
+    # Window scans 0..2: req 2 (same depth as 1, hotter) wins; req 4's
+    # top score sits OUTSIDE the window and cannot jump yet.
+    assert q.popleft().req_id == 2
+    assert q.popleft().req_id == 1  # window scans 0,1,3: 1 wins
+    assert q.popleft().req_id == 4  # 4 slid into the window
+    # 0 vs 3 tie at (0, 0): strictly-greater wins only -> FIFO.
+    assert [q.popleft().req_id, q.popleft().req_id] == [0, 3]
+    # A broken probe degrades to FIFO instead of raising out of pop.
+    q2 = AdmissionQueue(cfg)
+    q2.prefix_probe = lambda r: 1 // 0
+    for i in range(3):
+        q2.append(_Req(i, "t"))
+    assert [q2.popleft().req_id for _ in range(3)] == [0, 1, 2]
+    # cache_aware off: an installed probe is inert.
+    q3 = AdmissionQueue(SchedulerConfig())
+    q3.prefix_probe = lambda r: -r.req_id
+    for i in range(3):
+        q3.append(_Req(i, "t"))
+    assert [q3.popleft().req_id for _ in range(3)] == [0, 1, 2]
+
+
+def test_queue_cache_aware_defers_to_front_reinserts():
+    """A pool-pressure put-back (``appendleft``) must get the next pop
+    VERBATIM: the cache-aware scan is suppressed while a front
+    re-insert waits, else a hotter newcomer starves a request the
+    batcher already promised to retry."""
+    cfg = SchedulerConfig(cache_aware=True, cache_aware_window=8)
+    q = AdmissionQueue(cfg)
+    score = {0: 0, 1: 7, 2: 1}
+    q.prefix_probe = lambda r: score[r.req_id]
+    for i in range(3):
+        q.append(_Req(i, "t"))
+    r = q.popleft()
+    assert r.req_id == 1  # hottest jumped the queue
+    q.appendleft(r)  # alloc failed: put it back
+    score[2] = 99  # a now-hotter rival must NOT displace the put-back
+    assert q.popleft().req_id == 1
+    assert [q.popleft().req_id, q.popleft().req_id] == [2, 0]
+
+
+@pytest.mark.parametrize("aware", [True, False])
+def test_cache_aware_admission_prefers_resident_prefix(
+    clean_slate, batcher_factory, aware
+):
+    """End-to-end: with ``cache_aware`` on a paged batcher, a queued
+    request whose prefix is radix-RESIDENT admits before an
+    earlier-arrived cold peer of the same priority (suffix-only
+    prefill starts sooner while the pages are still hot); with it off
+    the identical traffic stays strict FIFO."""
+    rng = np.random.RandomState(31)
+    warm = rng.randint(0, 29, size=17).astype(np.int32)  # 2 full pages
+    cold = rng.randint(0, 29, size=17).astype(np.int32)
+    warm_again = np.concatenate(
+        [warm, rng.randint(0, 29, size=5).astype(np.int32)]
+    )
+    bat = batcher_factory(
+        layout="paged", slots=1,
+        scheduler=SchedulerConfig(cache_aware=aware),
+    )
+    bat.submit(warm, 3)
+    bat.run()  # retire: warm's full pages stay radix-resident (rc=0)
+    first: list[int] = []
+
+    def cb(rid, tok, idx):
+        if rid not in first:
+            first.append(rid)
+
+    b = bat.submit(cold, 3, on_token=cb)  # arrives first, cold
+    c = bat.submit(warm_again, 3, on_token=cb)  # arrives second, warm
+    bat.run()
+    assert first == ([c, b] if aware else [b, c])
+
+
 # -- bounded submit ----------------------------------------------------------
 
 
@@ -467,12 +553,25 @@ def test_degradation_ladder_escalates_and_recovers(
     assert warm == 0  # the warm request's id (sanity: nothing renumbered)
 
 
+@pytest.mark.parametrize(
+    "sample_kw",
+    [
+        {},
+        # temperature > 0 routes through the speculative-SAMPLING
+        # verify (accept/reject + residual resample), but top_k=1
+        # shapes the target to a point mass on its argmax — so the
+        # committed stream must STILL equal the greedy reference
+        # bit-for-bit whatever the ladder does to draft_k mid-serve.
+        {"temperature": 0.7, "top_k": 1},
+    ],
+    ids=["greedy", "sampled_topk1"],
+)
 def test_shrunk_draft_k_streams_stay_lossless(
-    clean_slate, batcher_factory
+    clean_slate, batcher_factory, sample_kw
 ):
     """set_draft_k mid-serve: the narrowed rounds still commit the
-    target's exact greedy stream (losslessness is the target's
-    property, not the draft depth's)."""
+    target's exact stream (losslessness is the target's property, not
+    the draft depth's) — in greedy mode AND in sampling mode."""
     p = np.arange(8, dtype=np.int32) % 29
     ref = batcher_factory(slots=1)
     rr = ref.submit(p, 16)
@@ -480,7 +579,10 @@ def test_shrunk_draft_k_streams_stay_lossless(
     bat = batcher_factory(
         draft=True, slots=1, speculative=SpeculativeConfig(draft_k=4)
     )
-    r = bat.submit(p, 16)
+    kw = dict(sample_kw)
+    if kw:
+        kw["rng"] = jax.random.PRNGKey(5)
+    r = bat.submit(p, 16, **kw)
     bat.tick()
     bat.set_draft_k(1)  # shrink mid-request
     bat.tick()
