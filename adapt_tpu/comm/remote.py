@@ -361,6 +361,16 @@ class RemoteStageServer:
                         self._telemetry = TelemetryReporter(
                             "stage", self.telemetry_worker
                         )
+                        # Capacity plane: a stage worker is a minimal
+                        # source — which stages it holds, so the fleet
+                        # capacity view shows it with first-class
+                        # staleness. Function-scoped import: comm must
+                        # not depend on runtime at module level.
+                        from adapt_tpu.runtime.capacity import stage_book
+
+                        self._telemetry.capacity_provider = (
+                            lambda: stage_book(len(self._stages))
+                        )
                     report = self._telemetry.collect()
                     # default=str: a non-JSON value (numpy scalar in a
                     # gauge or flight datum) degrades to its repr —

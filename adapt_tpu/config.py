@@ -755,6 +755,12 @@ class ObservabilityConfig:
     # flight event, WARNING, tracer instant event). Applied only when it
     # differs from this default (same rule as the ring capacities).
     compile_warmup: int = 8
+    # Rolling window for the windowed rate/attainment views: the
+    # continuous.goodput_tokens_s gauge's sample span and the capacity
+    # plane's decode-rate ceiling (runtime/capacity.CapacityModel) read
+    # the SAME window, so "goodput" means one thing across gauges and
+    # forecasts.
+    goodput_window_s: float = 2.0
 
     def __post_init__(self):
         if self.trace_capacity < 1:
@@ -763,6 +769,61 @@ class ObservabilityConfig:
             raise ValueError("flight_capacity must be >= 1")
         if self.compile_warmup < 0:
             raise ValueError("compile_warmup must be >= 0")
+        if self.goodput_window_s <= 0:
+            raise ValueError("goodput_window_s must be > 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class CapacityConfig:
+    """Replica capacity / placement-signal plane
+    (``runtime/capacity.CapacityModel``, docs/OBSERVABILITY.md
+    "Capacity & affinity signals").
+
+    Every batcher maintains a self-describing **capacity book**: a
+    headroom partition (slots/pages/queue), a self-calibrating TTFT
+    forecaster, a bounded prefix-affinity sketch, and a hysteresis
+    health score — everything a router needs to place a request
+    WITHOUT a per-replica prompt round-trip. All host-side, refreshed
+    off the critical path through the ``_obs_flush`` seam."""
+
+    #: Master switch. Off = no model attached: zero extra work per
+    #: submit/admit/commit/flush (the obs_overhead capacity arm's
+    #: floor).
+    enabled: bool = True
+    #: Min seconds between book rebuilds (headroom + sketch + health).
+    #: Feeds (queue-wait/prefill-wall EWMAs, calibration samples) are
+    #: O(1) appends regardless; this bounds the rebuild cadence.
+    refresh_s: float = 0.25
+    #: Prefix-affinity sketch bound: at most this many radix nodes
+    #: (hashed content keys), picked by token-weighted heat.
+    sketch_k: int = 32
+    #: EWMA learning rate for the forecaster's queue-wait, per-bucket
+    #: prefill-wall and bias-corrector estimates.
+    ewma_alpha: float = 0.2
+    #: Rolling count of (forecast, realized) TTFT pairs the
+    #: ``capacity.forecast_calibration`` fraction is computed over.
+    calibration_window: int = 256
+    #: Health hysteresis: a health IMPROVEMENT must hold this long
+    #: before the score follows it (worsening applies immediately —
+    #: a router should back off fast and return slowly).
+    health_dwell_s: float = 1.0
+    #: Min seconds between lease-meta book refreshes
+    #: (``WorkerRegistry`` re-register with ``meta["capacity"]``).
+    lease_refresh_s: float = 1.0
+
+    def __post_init__(self):
+        if self.refresh_s < 0:
+            raise ValueError("refresh_s must be >= 0")
+        if self.sketch_k < 1:
+            raise ValueError("sketch_k must be >= 1")
+        if not 0 < self.ewma_alpha <= 1:
+            raise ValueError("ewma_alpha must be in (0, 1]")
+        if self.calibration_window < 1:
+            raise ValueError("calibration_window must be >= 1")
+        if self.health_dwell_s < 0:
+            raise ValueError("health_dwell_s must be >= 0")
+        if self.lease_refresh_s < 0:
+            raise ValueError("lease_refresh_s must be >= 0")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -836,6 +897,9 @@ class ServeConfig:
     )
     runtime: RuntimeConfig = dataclasses.field(
         default_factory=RuntimeConfig
+    )
+    capacity: CapacityConfig = dataclasses.field(
+        default_factory=CapacityConfig
     )
     #: Hierarchical KV cache tier (None = off: evicted prefix pages
     #: die, today's behavior). Opt-in, unlike the sibling subsystem

@@ -270,7 +270,9 @@ from jax.sharding import (
 
 from adapt_tpu.config import (
     CacheTierConfig,
+    CapacityConfig,
     KernelConfig,
+    ObservabilityConfig,
     ParallelConfig,
     PrefillConfig,
     RecoveryConfig,
@@ -298,6 +300,7 @@ from adapt_tpu.parallel.sharding import (
     tree_shardings,
 )
 from adapt_tpu.parallel.sp_prefill import SPPrefiller, build_sp_mesh
+from adapt_tpu.runtime.capacity import CapacityModel
 from adapt_tpu.runtime.paged import (
     HostKVTier,
     Pager,
@@ -407,6 +410,11 @@ class _Request:
     #: admission (cleared there, so a pool-pressure re-queue or a
     #: recovery replay never double-decrements the group).
     fanout_group: int = -1
+    #: Submit-time TTFT forecast (``runtime/capacity``; 0.0 = no
+    #: capacity model, or nothing learned yet). Compared against the
+    #: realized TTFT at first-token commit — the forecaster's
+    #: self-calibration loop.
+    ttft_forecast_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -596,6 +604,8 @@ class ContinuousBatcher:
         prefill: PrefillConfig | None = None,
         sp_mesh: Mesh | None = None,
         runtime: RuntimeConfig | None = None,
+        observability: ObservabilityConfig | None = None,
+        capacity: CapacityConfig | None = None,
     ):
         self.lm = lm
         # -- tensor parallelism (mesh-native serving) ----------------------
@@ -1206,11 +1216,34 @@ class ContinuousBatcher:
         #: Rolling (t, good_tokens) per-tick samples spanning
         #: goodput_window_s — continuous.goodput_tokens_s is their rate
         #: (idle ticks append zeros, so the gauge decays instead of
-        #: scraping the last busy tick's rate forever).
-        self.goodput_window_s = 2.0
+        #: scraping the last busy tick's rate forever). The window is
+        #: ``ObservabilityConfig.goodput_window_s``, shared with the
+        #: capacity plane's windowed views.
+        self._obs_cfg = observability or ObservabilityConfig()
+        self.goodput_window_s = self._obs_cfg.goodput_window_s
         self._goodput_samples: collections.deque[tuple[float, int]] = (
             collections.deque()
         )
+        # -- capacity / placement-signal plane (runtime/capacity) ----------
+        #: The self-describing replica book: headroom, self-calibrating
+        #: TTFT forecaster, prefix-affinity sketch, hysteresis health.
+        #: Feeds are O(1) stamps on the submit/admit/commit sites;
+        #: rebuilds ride the _obs_flush seam, rate-limited. None when
+        #: ``CapacityConfig(enabled=False)`` — zero extra work anywhere
+        #: (the obs_overhead capacity arm's floor).
+        cap_cfg = capacity or CapacityConfig()
+        self._capacity: CapacityModel | None = (
+            CapacityModel(
+                cap_cfg, kind="decode",
+                window_s=self.goodput_window_s,
+            )
+            if cap_cfg.enabled
+            else None
+        )
+        #: Previous _obs_flush stamp — the tick-gap EWMA feed (the
+        #: forecaster's "how long until a queued request's next pickup
+        #: opportunity" term). 0.0 until the first flush.
+        self._cap_last_flush = 0.0
         #: Engine-tier observability (utils.profiling): per-phase tick
         #: timing behind the process-global EngineObs gate (one branch
         #: per phase when off), plus the compile sentinel sampled once
@@ -2726,6 +2759,19 @@ class ContinuousBatcher:
             slo=slo,
             fanout_group=_fanout,
         )
+        if self._capacity is not None:
+            # Submit-time TTFT forecast (client thread): the radix
+            # probe is a read-only dict walk (same thread stance as
+            # prefix_cached), and the forecaster feeds are per-field
+            # scalar reads. Stored on the request; its realized TTFT
+            # closes the calibration loop at first-token commit.
+            hit_tokens = 0
+            if self._paged:
+                hit_tokens = self._pager.radix_probe(prompt)[1]
+            req.ttft_forecast_s = self._capacity.forecast_ttft(
+                s0, hit_tokens
+            )
+
         def _reject(e: QueueFullError, journaled: bool) -> None:
             self._record_rejection(
                 request_tenant(req), request_priority(req), e,
@@ -3831,6 +3877,14 @@ class ContinuousBatcher:
             # by the PREVIOUS span, so the rate sums the later samples.
             good = sum(g for _, g in list(gs)[1:])
             reg.set_gauge("continuous.goodput_tokens_s", good / span)
+        if self._capacity is not None:
+            # Capacity plane: tick-gap feed + (rate-limited inside
+            # update) book rebuild, sketch refresh, health scoring and
+            # the capacity.* gauges. Same seam, same obs budget.
+            if self._cap_last_flush:
+                self._capacity.on_tick_gap(now - self._cap_last_flush)
+            self._cap_last_flush = now
+            self._capacity.update(self, now)
 
     def _release_slot(self, slot: _Slot) -> None:
         """Reset one slot's host-side lifecycle state and return its
@@ -3978,6 +4032,14 @@ class ContinuousBatcher:
                     # it.
                     ttft = now - req.t_submit
                     self._ttft_pending.append(ttft)
+                    if (
+                        self._capacity is not None
+                        and req.ttft_forecast_s > 0.0
+                    ):
+                        # Close the forecast loop: realized-vs-forecast
+                        # pairs drain in _obs_flush (calibration gauge,
+                        # abs-error histogram, bias update).
+                        self._capacity.on_ttft(req.ttft_forecast_s, ttft)
                     if req.slo is not None and (
                         req.slo.ttft_budget_s is not None
                     ):
@@ -4144,6 +4206,16 @@ class ContinuousBatcher:
             )
             tracer = global_tracer()
             t0 = tracer.now() if tracer.enabled else 0.0
+            # Capacity forecaster feed: the admission prefill's wall is
+            # measured through the first-token host sync below (the
+            # tracer stamp above may be disabled; this one is gated on
+            # the capacity plane instead). cow (zero positions) and
+            # chunked (spread over ticks) admissions skip the feed —
+            # the forecaster's calibration bias absorbs them.
+            cap_t0 = (
+                time.perf_counter() if self._capacity is not None else 0.0
+            )
+            cap_tokens = 0
             first = None
             if chunked:
                 # Chunked prefill: park the slot in the prefilling state
@@ -4224,6 +4296,7 @@ class ContinuousBatcher:
                     nucleus=req.top_p < 1.0,
                 )
                 self._count_prefill(slen)
+                cap_tokens = slen
             else:
                 ids = np.zeros((1, bucket), np.int32)
                 ids[0, :s0] = req.prompt
@@ -4255,6 +4328,7 @@ class ContinuousBatcher:
                         self._caches, self._h2d(np.int32(i)), kvs
                     )
                 self._count_prefill(s0)
+                cap_tokens = s0
             if self._paged and not chunked:
                 # Publish this request's full prompt pages for future
                 # sharing (first writer wins; the shared ones are
@@ -4295,6 +4369,12 @@ class ContinuousBatcher:
                     tok0, lp0 = fg.first, fg.first_lp
                 else:
                     tok0, lp0 = int(first[0]), float(first_lp[0])
+                    if self._capacity is not None and cap_tokens:
+                        # The int() above is the host sync, so this
+                        # wall covers dispatch AND compute.
+                        self._capacity.on_prefill(
+                            cap_tokens, time.perf_counter() - cap_t0
+                        )
             with self._cv:
                 self._admitting = None  # slot-bound: visible to cancel()
                 self._admitted += 1
@@ -4337,6 +4417,8 @@ class ContinuousBatcher:
             queue_wait = time.perf_counter() - (
                 req.t_requeued or req.t_submit
             )
+            if self._capacity is not None:
+                self._capacity.on_queue_wait(queue_wait)
             if self.obs_timeline:
                 global_metrics().observe(
                     "continuous.queue_wait_s", queue_wait
@@ -4995,6 +5077,16 @@ class ContinuousBatcher:
             eo.phase("update", t_ph)
         self._sentinel.sample(write_gauges=False)
         return fl.n_active
+
+    def capacity_book(self) -> dict | None:
+        """The capacity plane's last rebuilt book (None when the plane
+        is disabled). JSON-safe — the exact object telemetry providers
+        and lease meta advertise; its ``wall`` stamp lets any consumer
+        age it. Before the first ``_obs_flush`` rebuild this is the
+        constructor's empty-headroom book, still well-formed."""
+        if self._capacity is None:
+            return None
+        return self._capacity.book()
 
     def stats(self) -> dict:
         """Serving observability snapshot: slot occupancy, queue depth,

@@ -93,6 +93,7 @@ from adapt_tpu.comm.framing import (
 from adapt_tpu.config import DisaggConfig, PrefillConfig, SLOSpec
 from adapt_tpu.models.transformer_lm import TransformerLM
 from adapt_tpu.parallel.sp_prefill import SPPrefiller, build_sp_mesh
+from adapt_tpu.runtime.capacity import prefill_tier_book
 from adapt_tpu.runtime.continuous import ContinuousBatcher
 from adapt_tpu.runtime.paged import Pager
 from adapt_tpu.runtime.scheduler import QueueFullError
@@ -879,6 +880,18 @@ class DisaggServer:
                 meta=dict(self._lease_meta),
                 ttl_s=lease_ttl_s,
             )
+        #: Lease-meta capacity book refresh (rate-limited): the
+        #: prefill tier's ``/fleet/capacity`` path. register() on an
+        #: EXISTING key replaces meta and renews the lease without
+        #: firing join watchers, so the refresh is free of membership
+        #: side effects. Gated on the decode batcher's capacity plane
+        #: — ``CapacityConfig(enabled=False)`` is ONE switch for the
+        #: whole replica.
+        cap = decode._capacity
+        self._cap_lease_s = (
+            cap.cfg.lease_refresh_s if cap is not None else 0.0
+        )
+        self._cap_last_lease = 0.0
         #: Drain switch (close()): stops lease keepalive/resurrection
         #: so the placement policy falls back to collocated for good.
         self._closed = False
@@ -1172,6 +1185,22 @@ class DisaggServer:
                 meta=dict(self._lease_meta),
                 ttl_s=self._lease_ttl,
             )
+        if (
+            self._registry is not None
+            and not self._closed
+            and self._cap_lease_s > 0
+        ):
+            cap_now = time.monotonic()
+            if cap_now - self._cap_last_lease >= self._cap_lease_s:
+                self._cap_last_lease = cap_now
+                self._lease_meta["capacity"] = prefill_tier_book(
+                    self.prefill
+                )
+                self._lease_token = self._registry.register(
+                    self._lease_key,
+                    meta=dict(self._lease_meta),
+                    ttl_s=self._lease_ttl,
+                )
         for handoff in self.prefill.step():
             self._land(handoff)
         if self.prefill.failed_jobs:
@@ -1281,6 +1310,19 @@ class DisaggServer:
     @property
     def prompt_buckets(self):
         return self.decode.prompt_buckets
+
+    def capacity_book(self) -> dict | None:
+        """One self-describing book for the whole disaggregated pair:
+        the decode batcher's capacity book with the prefill tier's
+        book nested under ``"prefill"`` (None when the capacity plane
+        is disabled). What a DisaggServer process hands
+        ``serve_metrics(capacity_provider=...)``."""
+        book = self.decode.capacity_book()
+        if book is None:
+            return None
+        book = dict(book)
+        book["prefill"] = prefill_tier_book(self.prefill)
+        return book
 
     def stats(self) -> dict:
         out = self.decode.stats()

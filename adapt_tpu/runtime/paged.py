@@ -372,6 +372,27 @@ class Pager:
             heat += node.hits
         return (pages, pages * self.page_tokens, heat)
 
+    def radix_sketch(self, k: int) -> list[tuple[bytes, int, int]]:
+        """Top-``k`` resident radix nodes by token-weighted heat:
+        ``[(content_key, depth, hits)]``, hottest first. Weight is
+        ``depth * (1 + hits)`` — depth counts the tokens a match at
+        this node saves, the ``1 +`` keeps never-hit (freshly
+        registered) deep prefixes rankable at all. Read-only snapshot
+        (``list()`` at C speed, same stats()-era discipline: exporter
+        threads may call while the ticking thread mutates) — the
+        capacity plane's affinity-sketch export
+        (``runtime/capacity.sketch_from_pager``)."""
+        if not self.page_tokens or k <= 0:
+            return []
+        items = list(self._radix.items())
+        items.sort(
+            key=lambda kv: (
+                kv[1].depth * (1 + kv[1].hits), kv[1].depth,
+            ),
+            reverse=True,
+        )
+        return [(key, n.depth, n.hits) for key, n in items[:k]]
+
     def adopt_cached(self, keys: list[bytes]) -> list[tuple[int, int]]:
         """Adopt EXTERNALLY prefilled prefix pages into the cache — the
         disaggregated-serving landing path (``runtime/disagg``): for

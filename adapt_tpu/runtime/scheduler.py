@@ -402,6 +402,17 @@ class AdmissionQueue:
         before) — the ``scheduler.queue_depth.<tenant>`` gauges."""
         return dict(self._tenant_depth)
 
+    def pressure(self) -> tuple[int, int, dict[str, int]]:
+        """``(depth, bound, per-tenant depths)`` in one call — the
+        capacity book's queue-pressure read (``runtime/capacity``),
+        kept here so the book and the admission bound can never read
+        different notions of "full"."""
+        return (
+            self._depth,
+            int(self.cfg.max_queue_depth),
+            dict(self._tenant_depth),
+        )
+
     def preempt_candidate(self):
         """The waiting request preemption would serve: the tenant-queue
         head in the highest non-empty priority class that has burned
@@ -453,6 +464,12 @@ class DegradationController:
         "evict_cached",
         "reject_best_effort",
     )
+
+    @property
+    def rung(self) -> str:
+        """Name of the deepest rung currently applied (``""`` at level
+        0) — the capacity book's human-readable degradation field."""
+        return self.LADDER[self.level - 1] if self.level > 0 else ""
 
     def __init__(self, cfg: SchedulerConfig):
         self.cfg = cfg

@@ -29,6 +29,10 @@ stdlib ``ThreadingHTTPServer`` serving
   sources' shipped reservoirs,
 - ``GET /fleet/events`` — the merged, wall-clock-ordered flight
   stream across sources,
+- ``GET /fleet/capacity`` — the merged capacity plane
+  (``FederatedStore.capacity_snapshot``): per-replica headroom /
+  TTFT-forecast / prefix-affinity-sketch / health books, labeled
+  role/worker/pid with first-class staleness,
 - ``GET /telemetry.json`` — this process's own
   ``TelemetryReporter.collect()`` body: the HTTP-PULL federation
   fallback for processes the dispatcher has no comm link to (advertise
@@ -335,6 +339,7 @@ def serve_metrics(
     role: str = "server",
     worker: str | None = None,
     journal=None,
+    capacity_provider=None,
 ) -> ThreadingHTTPServer:
     """Start the exporter on a daemon thread; returns the server
     (``.server_address[1]`` is the bound port). Stop with
@@ -347,7 +352,10 @@ def serve_metrics(
     source, so ``/fleet/*`` always includes the serving process's own
     telemetry next to its workers'. ``journal`` (a
     ``control.journal.DispatcherJournal``) enriches
-    ``/debug/request/<id>`` with submit metadata."""
+    ``/debug/request/<id>`` with submit metadata. ``capacity_provider``
+    (zero-arg -> capacity book dict, e.g. a batcher's
+    ``capacity_book``) makes this process a ``/fleet/capacity`` source
+    and stamps the book onto ``/telemetry.json`` pulls."""
     reg = registry if registry is not None else global_metrics()
     tr = tracer if tracer is not None else global_tracer()
     rec = recorder if recorder is not None else global_flight_recorder()
@@ -371,7 +379,8 @@ def serve_metrics(
     # the staleness gauges (fleet.report_age_s.<source>) land on the
     # served registry so a plain /metrics scrape sees a wedged worker.
     fed.attach_local(
-        role, worker, registry=reg, recorder=rec, tracer=tr
+        role, worker, registry=reg, recorder=rec, tracer=tr,
+        capacity_provider=capacity_provider,
     )
     reg.register_collector(fed.collector)
     if journal is not None:
@@ -386,6 +395,7 @@ def serve_metrics(
         recorder=rec,
         tracer=tr,
     )
+    pull_reporter.capacity_provider = capacity_provider
     t_start = time.monotonic()
 
     class Handler(BaseHTTPRequestHandler):
@@ -436,6 +446,13 @@ def serve_metrics(
             elif path == "/fleet/events":
                 fed.refresh()
                 body = _json_bytes({"events": fed.events()})
+                ctype = "application/json"
+            elif path == "/fleet/capacity":
+                # The capacity plane: per-replica books (headroom,
+                # TTFT forecast, affinity sketch, health) labeled
+                # role/worker/pid with first-class age_s staleness —
+                # the router/autoscaler placement view.
+                body = _json_bytes(fed.capacity_snapshot())
                 ctype = "application/json"
             elif path == "/telemetry.json":
                 body = _json_bytes(pull_reporter.collect())

@@ -185,6 +185,12 @@ class TelemetryReporter:
         self._span_seq = 0
         self._seq = 0
         self._lock = threading.Lock()
+        #: Optional zero-arg callable returning this process's capacity
+        #: book (``runtime/capacity``): when set, every report carries
+        #: a ``"capacity"`` section — an OPTIONAL key, so stores and
+        #: wires that predate it ignore it instead of breaking (no
+        #: REPORT_V bump needed).
+        self.capacity_provider = None
 
     def collect(self) -> dict:
         """The next report. First call: cumulative-since-boot counters
@@ -240,7 +246,14 @@ class TelemetryReporter:
                 self._span_seq
             )
             self._seq += 1
-            return {
+            capacity = None
+            if self.capacity_provider is not None:
+                try:
+                    capacity = self.capacity_provider()
+                except Exception:  # noqa: BLE001 — a broken book must
+                    # not take the whole report (counters, events) down.
+                    log.exception("capacity provider failed")
+            report = {
                 "v": REPORT_V,
                 "source": {
                     "role": self.role,
@@ -264,6 +277,9 @@ class TelemetryReporter:
                 "spans": export_spans(spans)[-self._max_spans:],
                 "degraded": degraded and not first,
             }
+            if isinstance(capacity, dict):
+                report["capacity"] = capacity
+            return report
 
     def close(self) -> None:
         """Close the chained snapshot window (a retired reporter must
@@ -326,6 +342,11 @@ class _Source:
         self.last_mono = time.monotonic()
         self.last_wall = 0.0
         self.degraded = 0
+        #: Last capacity book this source shipped (reports carry it as
+        #: an optional section) + its arrival stamp — a killed source's
+        #: book reads as GROWING age, never as fresh headroom.
+        self.capacity: dict | None = None
+        self.capacity_mono = 0.0
 
 
 class FederatedStore:
@@ -363,6 +384,13 @@ class FederatedStore:
         self._locals: dict[str, TelemetryReporter] = {}
         self._registries: list = []  # WorkerRegistry refs for polling
         self._poll_last: dict[str, float] = {}
+        #: Lease-advertised capacity books (``meta["capacity"]`` on a
+        #: WorkerRegistry lease — the disagg prefill tier's path):
+        #: ``worker_id -> (book, first-seen-mono-at-this-wall)``. The
+        #: mono stamp only advances when the book's ``wall`` does, and
+        #: entries OUTLIVE their lease — an expired or frozen source
+        #: reads as growing age, never as a fresh book.
+        self._lease_caps: dict[str, tuple[dict, float]] = {}
         self._journal = None
         self.poll_interval_s = 1.0
         self.poll_timeout_s = 1.0
@@ -376,11 +404,15 @@ class FederatedStore:
         registry: MetricsRegistry | None = None,
         recorder: FlightRecorder | None = None,
         tracer: Tracer | None = None,
+        capacity_provider=None,
     ) -> str:
         """Register this process itself as a source; its reporter is
         drained lazily at every :meth:`refresh` (scrape-time pull, no
         thread). Idempotent per (role, worker): re-attaching with the
-        same identity keeps the existing reporter and its cursors."""
+        same identity keeps the existing reporter and its cursors.
+        ``capacity_provider`` (zero-arg -> book dict) makes the local
+        source self-describing in ``/fleet/capacity``; passing one to
+        a re-attach updates the existing reporter's provider."""
         worker = worker if worker is not None else f"pid{os.getpid()}"
         key = source_key(role, worker, os.getpid())
         stale: TelemetryReporter | None = None
@@ -389,12 +421,16 @@ class FederatedStore:
             if existing is not None and existing._reg is (
                 registry if registry is not None else global_metrics()
             ):
+                if capacity_provider is not None:
+                    existing.capacity_provider = capacity_provider
                 return key
             stale = existing
-            self._locals[key] = TelemetryReporter(
+            rep = TelemetryReporter(
                 role, worker, registry=registry, recorder=recorder,
                 tracer=tracer,
             )
+            rep.capacity_provider = capacity_provider
+            self._locals[key] = rep
         if stale is not None:
             # OUTSIDE the lock: close() snapshots the old registry,
             # which runs its collectors — and this store's own
@@ -478,6 +514,10 @@ class FederatedStore:
                 # (the alternative — subtracting — would present a
                 # counter that went backwards to every scraper).
             s.gauges.update(report.get("gauges", {}))
+            cap = report.get("capacity")
+            if isinstance(cap, dict):
+                s.capacity = cap
+                s.capacity_mono = time.monotonic()
             for name, h in report.get("histograms", {}).items():
                 fh = s.hists.get(name)
                 if fh is None:
@@ -629,6 +669,62 @@ class FederatedStore:
             k: v["age_s"] for k, v in self.sources().items()
         }
         return out
+
+    def capacity_snapshot(self, refresh: bool = True) -> dict:
+        """The merged capacity plane ``GET /fleet/capacity`` serves:
+        one entry per replica that has shipped a book — telemetry-wire
+        sources (reports' optional ``capacity`` section) plus
+        lease-meta books (``meta["capacity"]`` on live registry
+        leases) — each labeled role/worker/pid with first-class
+        ``age_s`` staleness. A killed source's last book stays in the
+        view with GROWING age (placement must see "stale", not
+        "gone"); a router treats age above its own bound as no
+        capacity at all."""
+        if refresh:
+            self.refresh()
+        now = time.monotonic()
+        with self._lock:
+            registries = list(self._registries)
+        # Registry scan OUTSIDE self._lock (alive_meta takes the
+        # registry's own lock; same discipline as poll_registry).
+        lease_books: dict[str, dict] = {}
+        for registry in registries:
+            try:
+                for wid, meta in registry.alive_meta().items():
+                    book = meta.get("capacity")
+                    if isinstance(book, dict):
+                        lease_books[str(wid)] = book
+            except Exception:  # noqa: BLE001 — a wedged registry must
+                log.exception("capacity lease scan failed")
+        replicas: dict[str, dict] = {}
+        with self._lock:
+            for wid, book in lease_books.items():
+                prev = self._lease_caps.get(wid)
+                if prev is None or prev[0].get("wall") != book.get(
+                    "wall"
+                ):
+                    self._lease_caps[wid] = (book, now)
+            for key, s in self._sources.items():
+                if s.capacity is None:
+                    continue
+                replicas[key] = {
+                    "role": s.role,
+                    "worker": s.worker,
+                    "pid": s.pid,
+                    "via": "telemetry",
+                    "age_s": round(now - s.capacity_mono, 3),
+                    "book": s.capacity,
+                }
+            for wid, (book, mono) in self._lease_caps.items():
+                replicas[f"lease:{wid}"] = {
+                    "role": str(book.get("kind", "worker")),
+                    "worker": wid,
+                    "pid": 0,
+                    "via": "lease",
+                    "age_s": round(now - mono, 3),
+                    "book": book,
+                }
+        return {"v": REPORT_V, "replicas": replicas}
 
     def events(
         self,

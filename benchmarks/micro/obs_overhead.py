@@ -39,6 +39,16 @@ on a SECOND batcher running the pipelined tick runtime,
 row holds that seam to the SAME < 5% budget). Per-config per-tick
 means and the engine-only overhead ride in extras.
 
+A FOURTH gated line, ``micro_obs_overhead_capacity_pct``, measures the
+capacity/placement-signal plane (``runtime/capacity.CapacityModel``)
+on a PAIR of fresh paged batchers: one with
+``CapacityConfig(enabled=False)`` (the floor — no model attached, zero
+extra work anywhere) and one with ``refresh_s=0.0`` (book + sketch
+rebuilt EVERY flush — far more aggressive than the production 0.25 s
+cadence, so the measured overhead upper-bounds the real one). Both run
+with the default timeline config so the delta isolates the capacity
+arm alone. Same < 5% budget.
+
 Timing note (benchmarks/common.py): ticks end in a real host fetch of
 the chunk's tokens, so the region is honestly bounded per tick.
 
@@ -262,6 +272,79 @@ def main() -> int:
             ticks=n_ticks,
             trials=trials,
         )
+
+        # Capacity-plane arm: a fresh PAGED batcher pair (paged so the
+        # book rebuild pays the full bill — headroom from Pager.stats
+        # plus the radix affinity sketch). The floor batcher has the
+        # plane disabled (no model attached); the hot one rebuilds the
+        # book on EVERY flush (refresh_s=0.0, vs 0.25 s in production),
+        # so this upper-bounds the steady-state cost. Both keep the
+        # default timeline config: the delta is the capacity arm alone.
+        from adapt_tpu.config import CapacityConfig
+
+        page = 16
+        csteps = (n_ticks * (trials + 1) + 8) * chunk
+        pool = slots * ((csteps + 48 + page) // page + 1) + 8
+        cbats = {}
+        for cname, ccfg in (
+            ("off", CapacityConfig(enabled=False)),
+            ("on", CapacityConfig(refresh_s=0.0)),
+        ):
+            cb = ContinuousBatcher(
+                lm, variables, slots=slots, chunk=chunk,
+                kv_layout="paged", page_size=page, pool_pages=pool,
+                capacity=ccfg,
+            )
+            for _ in range(slots):
+                # 3-page prompts so the radix tree (and therefore the
+                # sketch rebuild) has real content to walk.
+                cb.submit(
+                    rng.randint(0, 37, size=3 * page).astype(np.int32),
+                    csteps, slo=slo,
+                )
+            cb.tick()  # admission burst + paged-program compiles
+            cb.tick()
+            for _ in range(n_ticks):  # warm before any timed window
+                cb.tick()
+            cbats[cname] = cb
+        cbest = {"off": float("inf"), "on": float("inf")}
+        for t in range(trials):
+            order = ("off", "on") if t % 2 == 0 else ("on", "off")
+            for cname in order:
+                cb = cbats[cname]
+                t0 = time.perf_counter()
+                for _ in range(n_ticks):
+                    cb.tick()
+                cbest[cname] = min(
+                    cbest[cname], (time.perf_counter() - t0) / n_ticks
+                )
+        for cname, cb in cbats.items():
+            if cb.stats()["active"] != slots:
+                raise RuntimeError(
+                    f"capacity-{cname} batcher fell out of steady "
+                    "state mid-measure"
+                )
+        book = cbats["on"].capacity_book() or {}
+        for cb in cbats.values():
+            cb.close()
+        capacity_pct = (cbest["on"] / cbest["off"] - 1.0) * 100.0
+        emit(
+            "micro_obs_overhead_capacity_pct",
+            capacity_pct,
+            "% tick wall time (capacity book rebuilt every flush vs "
+            "plane disabled, paged batcher)",
+            BUDGET_PCT - capacity_pct,
+            budget_pct=BUDGET_PCT,
+            tick_capacity_off_ms=round(cbest["off"] * 1e3, 4),
+            tick_capacity_on_ms=round(cbest["on"] * 1e3, 4),
+            refresh_s=0.0,
+            sketch_entries=len(
+                book.get("sketch", {}).get("entries", ())
+            ),
+            slots=slots,
+            ticks=n_ticks,
+            trials=trials,
+        )
     except Exception as e:  # noqa: BLE001 — always one JSON line, rc 0
         emit(
             "micro_obs_overhead_pct", 0.0,
@@ -277,6 +360,13 @@ def main() -> int:
         emit(
             "micro_obs_overhead_async_pct", 0.0,
             "% tick wall time (trace vs off, pipelined depth-2 runtime)",
+            0.0,
+            error=str(e)[-300:],
+        )
+        emit(
+            "micro_obs_overhead_capacity_pct", 0.0,
+            "% tick wall time (capacity book rebuilt every flush vs "
+            "plane disabled, paged batcher)",
             0.0,
             error=str(e)[-300:],
         )

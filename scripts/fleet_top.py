@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""fleet_top — one line per replica from a fleet exporter.
+
+Read-only: polls ``GET /fleet/capacity`` (the capacity books every
+replica publishes — health, headroom, TTFT forecast, affinity-sketch
+size) and ``GET /fleet/metrics.json`` (per-source goodput gauges) from
+one ``serve_metrics`` exporter and renders the router's-eye view:
+
+    KEY                ROLE    VIA    AGE   HEALTH    SLOTS  PAGES  QUEUE  TTFT-FC  CAL   GOODPUT
+    decode:w0:4242     decode  telem  0.2s  ok         3/8    118   0.12   0.012s   0.94  1832.4
+
+No dependencies beyond the standard library (urllib), no mutation —
+safe to point at a live deployment.
+
+Usage::
+
+    python scripts/fleet_top.py --url http://127.0.0.1:9100 [--interval 2.0] [--once]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def _fetch(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return json.loads(r.read().decode())
+
+
+def _fmt_headroom(hr: dict) -> tuple[str, str, str]:
+    """(slots, pages, queue) columns; '-' when a tier doesn't book
+    that resource (stage workers have no slots, dense replicas no
+    pages)."""
+    if "slots_total" in hr:
+        slots = f"{hr.get('slots_free', 0)}/{hr.get('slots_total', 0)}"
+    elif "stages" in hr:
+        slots = f"st:{hr['stages']}"
+    else:
+        slots = "-"
+    pages = (
+        str(hr.get("pages_free", "-")) if "pages_total" in hr else "-"
+    )
+    if "queue_frac" in hr:
+        queue = f"{hr['queue_frac']:.2f}"
+    elif "queue_depth" in hr or "backlog" in hr:
+        queue = str(hr.get("queue_depth", hr.get("backlog", 0)))
+    else:
+        queue = "-"
+    return slots, pages, queue
+
+
+def _rows(caps: dict, fleet: dict) -> list[tuple]:
+    goodput = {
+        key: src.get("gauges", {}).get("continuous.goodput_tokens_s")
+        for key, src in fleet.get("sources", {}).items()
+    }
+    rows = []
+    for key in sorted(caps.get("replicas", ())):
+        rep = caps["replicas"][key]
+        book = rep.get("book", {})
+        fc = book.get("forecast", {})
+        slots, pages, queue = _fmt_headroom(book.get("headroom", {}))
+        # A replica's submit-time forecast for a bucket-8 cold prompt:
+        # bias * (queue wait + a mid bucket wall + tick gap) — enough
+        # to compare replicas at a glance.
+        walls = fc.get("walls", {})
+        wall = next(iter(sorted(walls.values())), 0.0) if walls else 0.0
+        est = fc.get("bias", 1.0) * (
+            fc.get("queue_wait_s", 0.0) + wall + fc.get("tick_gap_s", 0.0)
+        )
+        gp = goodput.get(key)
+        rows.append((
+            key[:24],
+            str(rep.get("role", "?"))[:8],
+            {"telemetry": "telem"}.get(rep.get("via"), rep.get("via")),
+            f"{rep.get('age_s', 0.0):.1f}s",
+            str(book.get("health", "?")),
+            slots,
+            pages,
+            queue,
+            f"{est:.3f}s" if est > 0 else "-",
+            (
+                f"{fc['calibration']:.2f}"
+                if fc.get("samples") else "-"
+            ),
+            f"{gp:.1f}" if gp is not None else "-",
+            str(len(book.get("sketch", {}).get("entries", ()))),
+        ))
+    return rows
+
+
+_HDR = (
+    "KEY", "ROLE", "VIA", "AGE", "HEALTH", "SLOTS", "PAGES",
+    "QUEUE", "TTFT-FC", "CAL", "GOODPUT", "SKETCH",
+)
+_W = (24, 8, 6, 7, 9, 7, 6, 6, 8, 5, 9, 6)
+
+
+def _render(rows: list[tuple]) -> str:
+    lines = ["  ".join(h.ljust(w) for h, w in zip(_HDR, _W))]
+    for row in rows:
+        lines.append(
+            "  ".join(str(c).ljust(w) for c, w in zip(row, _W))
+        )
+    if not rows:
+        lines.append("(no capacity books published yet)")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--url", default="http://127.0.0.1:9100",
+        help="exporter base URL (serve_metrics address)",
+    )
+    ap.add_argument(
+        "--interval", type=float, default=2.0,
+        help="seconds between polls",
+    )
+    ap.add_argument(
+        "--once", action="store_true",
+        help="print one snapshot and exit (no screen clearing)",
+    )
+    args = ap.parse_args(argv)
+    base = args.url.rstrip("/")
+    while True:
+        try:
+            caps = _fetch(base + "/fleet/capacity")
+            fleet = _fetch(base + "/fleet/metrics.json")
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"fleet_top: {base}: {e}", file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        out = _render(_rows(caps, fleet))
+        if args.once:
+            print(out)
+            return 0
+        # Home + clear-to-end, not full clear: no flicker on repaint.
+        sys.stdout.write("\x1b[H\x1b[J" + out + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,249 @@
+"""Capacity / placement-signal plane (``runtime/capacity``).
+
+The contract stack, bottom-up: the ``TTFTForecaster``'s EWMA + bias
+calibration math (snap-down from compile-scale outliers, bucket
+fallbacks, within-2x verdicts), the bounded prefix-affinity sketch and
+its static ``affinity_score`` (ranking a prefix-resident replica above
+a cold one from hashed sketches alone), ``HealthScore``'s
+worsen-fast/improve-slow hysteresis, and the full ``CapacityModel``
+book riding a real paged ``ContinuousBatcher`` — headroom partition
+reconciled against ``Pager.stats``, submit-time forecasts landing on
+requests, and the book staying JSON-safe."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from adapt_tpu.config import CapacityConfig
+from adapt_tpu.models.transformer_lm import lm_tiny
+from adapt_tpu.runtime.capacity import (
+    BOOK_V,
+    HealthScore,
+    TTFTForecaster,
+    affinity_score,
+    sketch_from_pager,
+    stage_book,
+)
+from adapt_tpu.runtime.continuous import ContinuousBatcher
+from adapt_tpu.runtime.paged import Pager
+
+
+# -- forecaster --------------------------------------------------------------
+
+
+def test_forecaster_cold_returns_zero_and_learns_additively():
+    f = TTFTForecaster(alpha=0.5)
+    assert f.forecast(32) == 0.0  # nothing learned: no estimate
+    f.observe_queue_wait(0.010)
+    f.observe_prefill(32, 0.020)
+    f.observe_tick_gap(0.005)
+    # bias starts at 1.0: the forecast is the sum of the three terms.
+    assert abs(f.forecast(32) - 0.035) < 1e-9
+    # A prefix hit shrinks the suffix into a different bucket.
+    assert f.forecast(32, prefix_hit_tokens=32) < f.forecast(32)
+
+
+def test_forecaster_bucket_fallbacks():
+    f = TTFTForecaster()
+    f.observe_prefill(16, 0.016)
+    # Unseen bucket: nearest learned bucket scaled by the token ratio.
+    assert abs(f._wall_for(64) - 0.016 * 4) < 1e-9
+    assert abs(f._wall_for(4) - 0.016 / 4) < 1e-9
+    # Empty walls entirely: per-token EWMA fallback.
+    g = TTFTForecaster()
+    assert g._wall_for(8) == 0.0
+    g._per_token = 0.001
+    assert abs(g._wall_for(8) - 0.008) < 1e-9
+
+
+def test_forecaster_snaps_down_from_compile_scale_walls():
+    """A wall 4x under the EWMA replaces it outright: warmup
+    admissions measure jit compiles through the same host sync as real
+    walls, and the steady state must not take 1/alpha admissions to
+    recover (the capacity_smoke train/measure protocol relies on
+    this)."""
+    f = TTFTForecaster(alpha=0.2)
+    f.observe_prefill(8, 2.0)  # compile-inflated
+    f.observe_prefill(8, 0.002)  # first real wall
+    assert f._walls[8] == 0.002  # snapped, not 2.0 * 0.8 + ...
+    # Upward moves still decay (one slow tick must not own the EWMA).
+    f.observe_prefill(8, 0.004)
+    assert 0.002 < f._walls[8] < 0.003
+    f.observe_queue_wait(1.0)
+    f.observe_queue_wait(0.001)
+    assert f._queue_wait == 0.001
+
+
+def test_forecaster_calibration_window_and_bias():
+    f = TTFTForecaster(alpha=0.2, window=8)
+    assert f.calibration() == 1.0  # empty window: unproven, not failing
+    assert f.record_realized(0.010, 0.012) is True  # within 2x
+    assert f.record_realized(0.010, 0.050) is False  # 5x out
+    assert f.calibration() == 0.5
+    # Ignored pairs (no forecast / no realized) never enter the books.
+    assert f.record_realized(0.0, 0.01) is False
+    assert f.record_realized(0.01, 0.0) is False
+    assert f.calibration() == 0.5
+    # Systematic 4x under-forecast drives the bias corrector UP until
+    # forecasts land within 2x.
+    f.observe_queue_wait(0.005)
+    for _ in range(40):
+        f.record_realized(f.forecast(4), 4 * 0.005)
+    assert f.forecast(4) > 2 * 0.005
+    assert f._bias > 1.0
+    # reset_calibration drops only the verdicts: walls + bias survive.
+    bias = f._bias
+    f.reset_calibration()
+    assert f.calibration() == 1.0 and f._bias == bias
+    assert f._queue_wait == 0.005
+    snap = f.snapshot()
+    assert snap["samples"] > 0 and json.loads(json.dumps(snap)) == snap
+
+
+# -- affinity sketch ---------------------------------------------------------
+
+
+def _registered_pager(prompts, P=4, num_pages=32):
+    """A pager with each prompt's full shareable page run registered
+    (the admission-side path, minus the batcher)."""
+    p = Pager(num_pages=num_pages, slots=4, pages_per_slot=8, page_tokens=P)
+    for slot, toks in enumerate(prompts):
+        toks = np.asarray(toks, np.int32)
+        pages = (len(toks) - 1) // P
+        assert p.alloc(slot, pages)
+        for j, page in enumerate(p.owned(slot)[:pages]):
+            p.register(page, Pager.prefix_key(toks, (j + 1) * P))
+    return p
+
+
+def test_sketch_bounded_and_affinity_ranks_resident_over_cold():
+    P = 4
+    hot = np.arange(100, 117, dtype=np.int32)  # 4 shareable pages
+    resident = _registered_pager([hot], P=P)
+    sk = sketch_from_pager(resident, k=32)
+    assert sk["v"] == BOOK_V and sk["page_tokens"] == P
+    assert len(sk["entries"]) == 4
+    # Hashed content keys only: no raw tokens leave the replica.
+    assert all(set(e) == {"h", "d", "t", "heat"} for e in sk["entries"])
+    probe = np.concatenate([hot, [1, 2, 3]]).astype(np.int32)
+    score = affinity_score(sk, probe)
+    assert score >= 16.0  # all four pages matched, token-weighted
+    cold = sketch_from_pager(
+        Pager(num_pages=32, slots=4, pages_per_slot=8, page_tokens=P), k=32
+    )
+    assert affinity_score(cold, probe) == 0.0
+    assert score > affinity_score(cold, probe)
+    # An unrelated prompt scores cold on the resident sketch too.
+    assert affinity_score(sk, np.arange(900, 917, dtype=np.int32)) == 0.0
+    # Malformed / versioned-away sketches degrade to 0.0, never raise.
+    assert affinity_score({"v": 99}, probe) == 0.0
+    assert affinity_score({}, probe) == 0.0
+
+
+def test_sketch_top_k_eviction_prefers_deep_paths():
+    P = 4
+    deep = np.arange(50, 63, dtype=np.int32)  # 3-page path
+    churn = [
+        np.arange(1000 + 10 * i, 1000 + 10 * i + 5, dtype=np.int32)
+        for i in range(3)  # depth-1 noise
+    ]
+    p = _registered_pager([deep] + churn, P=P)
+    sk = sketch_from_pager(p, k=2)
+    assert len(sk["entries"]) <= 2
+    # Weight = depth * (1 + hits): the deep path's nodes out-rank the
+    # shallow churn, so the bounded sketch still scores the deep probe.
+    probe = np.concatenate([deep, [7, 7, 7]]).astype(np.int32)
+    assert affinity_score(sk, probe) >= 2 * P
+
+
+# -- health hysteresis -------------------------------------------------------
+
+
+def test_health_worsens_fast_improves_after_dwell():
+    h = HealthScore(dwell_s=1.0)
+    assert h.level == 0 and h.name == "ok"
+    assert h.update(2, now=10.0) == 2  # worsening applies immediately
+    assert h.name == "critical"
+    assert h.update(0, now=10.5) == 2  # improvement pending, in dwell
+    assert h.update(0, now=10.9) == 2
+    assert h.update(1, now=11.0) == 2  # candidate changed: dwell restarts
+    assert h.update(1, now=11.9) == 2
+    assert h.update(1, now=12.1) == 1  # held 1.1s >= dwell: published
+    assert h.update(2, now=12.2) == 2  # re-worsen is instant again
+    # A worsening mid-dwell cancels the pending improvement.
+    assert h.update(0, now=13.0) == 2
+    assert h.update(2, now=13.5) == 2
+    assert h.update(0, now=14.2) == 2  # dwell restarted at 14.2, not 13.0
+    assert h.update(0, now=15.3) == 0
+
+
+# -- the full book on a live paged batcher -----------------------------------
+
+
+def test_capacity_book_on_paged_batcher_reconciles_headroom():
+    lm = lm_tiny(vocab=31, max_len=96)
+    variables = lm.graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )
+    bat = ContinuousBatcher(
+        lm, variables, slots=2, chunk=4,
+        kv_layout="paged", page_size=8,
+        capacity=CapacityConfig(refresh_s=0.0),
+    )
+    cap = bat._capacity
+    assert cap is not None
+    rng = np.random.RandomState(0)
+    bat.submit(rng.randint(1, 31, size=18).astype(np.int32), 12)
+    for _ in range(5):
+        bat.tick()
+    book = cap.refresh_book(bat)
+    hr = book["headroom"]
+    assert book["v"] == BOOK_V and book["kind"] == "decode"
+    assert hr["slots_total"] == 2
+    assert hr["slots_free"] == sum(
+        1 for s in bat.slots if s.req is None
+    )
+    ps = bat._pager.stats()
+    assert hr["pages_total"] == ps.num_pages
+    assert hr["pages_in_use"] == ps.in_use and hr["pages_free"] == ps.free
+    # Pager partition: page 0 is the never-allocated trash page, and
+    # "free" counts the evictable cache (cached <= free).
+    assert hr["pages_free"] + hr["pages_in_use"] == hr["pages_total"] - 1
+    assert hr["pages_cached"] <= hr["pages_free"]
+    assert 0.0 <= hr["queue_frac"] <= 1.0
+    assert json.loads(json.dumps(book)) == book  # wire-safe
+    # The first admission trained the forecaster through the live
+    # _admit seam: a second submit carries a positive forecast.
+    rid = bat.submit(rng.randint(1, 31, size=10).astype(np.int32), 4)
+    req = next(r for r in bat._queue if r.req_id == rid)
+    assert req.ttft_forecast_s > 0.0
+    bat.run()
+    bat.tick()  # idle flush: pending (forecast, realized) pairs drain
+    assert cap.forecaster._samples >= 1
+    assert 0.0 <= cap.calibration() <= 1.0
+    assert bat.capacity_book()["forecast"]["samples"] >= 1
+    bat.close()
+
+
+def test_capacity_disabled_attaches_nothing():
+    lm = lm_tiny(vocab=31, max_len=64)
+    variables = lm.graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )
+    bat = ContinuousBatcher(
+        lm, variables, slots=2, chunk=4,
+        capacity=CapacityConfig(enabled=False),
+    )
+    assert bat._capacity is None and bat.capacity_book() is None
+    bat.submit(np.arange(1, 7, dtype=np.int32), 4)
+    bat.run()  # the gated sites are all no-ops end to end
+    bat.close()
+
+
+def test_stage_book_shape():
+    b = stage_book(3, backlog=2)
+    assert b["v"] == BOOK_V and b["kind"] == "stage"
+    assert b["headroom"] == {"stages": 3, "backlog": 2}
+    assert json.loads(json.dumps(b)) == b

@@ -585,7 +585,12 @@ def test_two_process_fleet_metrics_forensics_and_staleness(
     under role/worker labels with the worker's histogram percentiles
     present; /debug/request/<id> returns one bundle whose
     events/spans span both processes; killing the worker flips its
-    fleet.report_age_s staleness signal."""
+    fleet.report_age_s staleness signal. Capacity plane riding the
+    same transports: the worker's MSG_TELEMETRY reports carry its
+    stage book, a registry lease advertises another, and
+    /fleet/capacity merges them with the local provider's — each
+    replica labeled and aged per source, the killed worker's age
+    growing instead of its book freezing silently."""
     from adapt_tpu.comm.remote import RemoteWorkerProxy
     from adapt_tpu.config import (
         FaultConfig,
@@ -643,9 +648,21 @@ def test_two_process_fleet_metrics_forensics_and_staleness(
     )
     disp.attach_worker(proxy)
     disp.start()
-    server = serve_metrics(port=0, role="server", worker="disp0")
+    from adapt_tpu.runtime.capacity import stage_book
+
+    server = serve_metrics(
+        port=0, role="server", worker="disp0",
+        capacity_provider=lambda: stage_book(2),
+    )
     http = server.server_address[1]
     gstore = global_federated_store()
+    # A third capacity source: a registry lease advertising its book
+    # in meta["capacity"] (the DisaggServer path, minus the server).
+    gstore.attach_registry(disp.registry)
+    disp.registry.register(
+        "cap-lease-0", meta={"capacity": stage_book(1, backlog=3)},
+        ttl_s=60.0,
+    )
     try:
         proxy.start()
         proxy.configure(1, None, plan.extract_variables(variables)[1])
@@ -704,6 +721,28 @@ def test_two_process_fleet_metrics_forensics_and_staleness(
             for e in evs
         )
 
+        # /fleet/capacity: three replica books over three transports —
+        # the worker's rode MSG_TELEMETRY, the lease one rides
+        # registry meta, the local provider's rides its reporter —
+        # each labeled and aged per source.
+        body, ctype = _get(http, "/fleet/capacity")
+        assert ctype.startswith("application/json")
+        caps = json.loads(body)["replicas"]
+        wcap = caps[wkey]
+        assert wcap["via"] == "telemetry"
+        assert wcap["book"]["kind"] == "stage"
+        assert wcap["book"]["headroom"]["stages"] >= 1
+        assert wcap["age_s"] < 5.0
+        lease = caps["lease:cap-lease-0"]
+        assert lease["via"] == "lease"
+        assert lease["book"]["headroom"]["backlog"] == 3
+        local = [
+            c for k, c in caps.items()
+            if c["via"] == "telemetry" and c["worker"] == "disp0"
+        ]
+        assert local and local[0]["book"]["headroom"]["stages"] == 2
+        assert local[0]["pid"] == os.getpid()
+
         # Forensics: one bundle, both processes present.
         body, _ = _get(http, f"/debug/request/{rid}")
         bundle = json.loads(body)
@@ -737,6 +776,10 @@ def test_two_process_fleet_metrics_forensics_and_staleness(
             ("adapt_fleet_report_age_s",
              frozenset([f'source="{wkey}"']))
         ] > 0.9
+        # The killed worker's capacity book stays listed with a
+        # GROWING age — a router sees staleness, not a frozen book.
+        caps = json.loads(_get(http, "/fleet/capacity")[0])["replicas"]
+        assert caps[wkey]["age_s"] > 0.9
     finally:
         server.shutdown()
         server.server_close()
