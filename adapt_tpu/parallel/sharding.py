@@ -440,6 +440,26 @@ def fetch_head_shards(x, index: int, head_dim: int = 1):
     )
 
 
+def head_tiles(kv_heads: int, parts: int) -> list[tuple[int, int]]:
+    """Contiguous even ``(lo, hi)`` head-axis tiles — the slices a
+    ``kv_head_sharding`` destination of ``parts`` shards reads off
+    ``devices_indices_map``, computable WITHOUT the destination's mesh
+    in hand (the cross-replica sender knows only the peer's tp). A
+    sender framing KV pages per tile ships exactly the bytes each
+    destination shard will ``device_put`` — the aligned-union wire
+    counterpart of :meth:`KVHandoffPlan.place` (2211.05322: point to
+    point, never a global gather)."""
+    kv_heads, parts = int(kv_heads), int(parts)
+    if parts < 1:
+        raise ValueError(f"parts must be >= 1, got {parts}")
+    if kv_heads < 1 or kv_heads % parts:
+        raise ValueError(
+            f"{parts} tiles must evenly cover {kv_heads} kv heads"
+        )
+    w = kv_heads // parts
+    return [(i * w, (i + 1) * w) for i in range(parts)]
+
+
 def plan_kv_handoff(sharding) -> KVHandoffPlan:
     """Build the :class:`KVHandoffPlan` for a destination pool's
     sharding (None for a no-mesh pool)."""

@@ -292,6 +292,40 @@ def affinity_score(sketch: dict, prompt) -> float:
     return float(best_tokens) + min(float(heat), 999.0) * 1e-3
 
 
+def forecast_from_snapshot(
+    snap: dict, prompt_len: int, prefix_hit_tokens: int = 0
+) -> float:
+    """Router-side TTFT forecast from a SHIPPED book's ``forecast``
+    section (:meth:`TTFTForecaster.snapshot`) — the static sibling of
+    :func:`affinity_score`: the same bucket-walk
+    :meth:`TTFTForecaster.forecast` runs replica-side, replayed from
+    the wire snapshot with no replica round-trip. Returns seconds;
+    0.0 = the replica has learned nothing yet (callers fall back to
+    headroom — least-loaded — exactly as a cold replica deserves)."""
+    if not isinstance(snap, dict) or not snap:
+        return 0.0
+    suffix = max(0, int(prompt_len) - int(prefix_hit_tokens))
+    wall = 0.0
+    walls = snap.get("walls") or {}
+    if suffix > 0 and walls:
+        by_bucket = {int(k): float(v) for k, v in walls.items()}
+        b = _pow2_bucket(suffix)
+        w = by_bucket.get(b)
+        if w is None:
+            # Nearest learned bucket scaled by the token ratio — the
+            # forecaster's own coarse interpolation, mirrored.
+            near = min(by_bucket, key=lambda k: abs(k - b))
+            w = by_bucket[near] * (b / near)
+        wall = w
+    raw = (
+        float(snap.get("queue_wait_s") or 0.0)
+        + wall
+        + float(snap.get("tick_gap_s") or 0.0)
+    )
+    bias = float(snap.get("bias") or 1.0)
+    return bias * raw if raw > 0 else 0.0
+
+
 class HealthScore:
     """``ok | degraded | critical`` with dwell hysteresis.
 

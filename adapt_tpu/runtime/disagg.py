@@ -175,7 +175,50 @@ def handoff_raw_nbytes(handoff: KVHandoff) -> int:
     return sum(int(arr.nbytes) for arr in _leaves(handoff))
 
 
-def pack_handoff(handoff: KVHandoff, wire_codec: str = "raw") -> Message:
+def _wire_tensors(
+    handoff: KVHandoff, head_ranges: list[tuple[int, int]] | None
+) -> list[np.ndarray]:
+    """The tensors actually framed, in wire order. Without
+    ``head_ranges`` this is :func:`_leaves` verbatim. With them, every
+    KV leaf (never the prompt) ships as one contiguous slice per
+    ``(lo, hi)`` destination head tile — sender-side resharding: the
+    wire already carries the aligned-union slices the destination's
+    :class:`~adapt_tpu.parallel.sharding.KVHandoffPlan` would cut, so
+    a tp=2 prefill tier feeds a tp=4 decode replica without either
+    side materializing a cross-mesh gather. The ranges must tile the
+    head axis exactly (``parallel.sharding.head_tiles`` builds legal
+    ones) or this raises — a slicing the receiver cannot reassemble
+    must fail at pack time, by name."""
+    leaves = _leaves(handoff)
+    if not head_ranges:
+        return leaves
+    out = [leaves[0]]
+    for arr in leaves[1:]:
+        h = int(arr.shape[1])
+        cover = 0
+        for lo, hi in head_ranges:
+            if int(lo) != cover or hi <= lo:
+                raise HandoffError(
+                    f"head_ranges {head_ranges} do not tile the "
+                    f"{h}-head axis contiguously"
+                )
+            cover = int(hi)
+        if cover != h:
+            raise HandoffError(
+                f"head_ranges cover {cover} of {h} kv heads"
+            )
+        for lo, hi in head_ranges:
+            # One contiguous copy per tile — the same bytes a
+            # destination shard's device_put would stage anyway.
+            out.append(np.ascontiguousarray(arr[:, lo:hi]))
+    return out
+
+
+def pack_handoff(
+    handoff: KVHandoff,
+    wire_codec: str = "raw",
+    head_ranges: list[tuple[int, int]] | None = None,
+) -> Message:
     """Frame a handoff for the comm tier: every tensor becomes one
     zero-copy codec frame (``codec.pack_frames`` with the raw codec —
     scatter-write parts, no payload copy; ``codec.copy_stats()`` pins
@@ -189,16 +232,25 @@ def pack_handoff(handoff: KVHandoff, wire_codec: str = "raw") -> Message:
     and int value planes always pack lossless). The annex then
     carries per-tensor codec meta, and the crc is computed over the
     COMPRESSED payload — corruption is detected before any decode
-    touches the bytes, exactly like the raw path."""
+    touches the bytes, exactly like the raw path.
+
+    ``head_ranges`` (destination head tiles from
+    ``parallel.sharding.head_tiles``) reshards SENDER-SIDE: each KV
+    tensor frames as one slice per tile, in tile order, and the annex
+    records the tiling so :func:`unpack_handoff` can reassemble the
+    full head range — the cross-replica tp-mismatch wire (a tp=2
+    prefill pool feeding a tp=4 decode replica ships four 2-head
+    slices per leaf, never a gathered whole)."""
     parts: list = []
     frame_lens: list[int] = []
     crc = 0
     leaf_meta: list[dict] | None = None
+    wire = _wire_tensors(handoff, head_ranges)
     if wire_codec != "raw":
         from adapt_tpu.ops.quantize import encode_page
 
         leaf_meta = []
-        for arr in _leaves(handoff):
+        for arr in wire:
             payload, meta = encode_page(np.asarray(arr), wire_codec)
             frame_lens.append(len(payload))
             leaf_meta.append(meta)
@@ -206,7 +258,7 @@ def pack_handoff(handoff: KVHandoff, wire_codec: str = "raw") -> Message:
             parts.append(memoryview(payload))
     else:
         raw = codec.get_codec("none")
-        for arr in _leaves(handoff):
+        for arr in wire:
             frames = codec.pack_frames(raw, arr)
             frame_lens.append(codec.frames_nbytes(frames))
             for p in frames:
@@ -228,6 +280,10 @@ def pack_handoff(handoff: KVHandoff, wire_codec: str = "raw") -> Message:
         "frame_lens": frame_lens,
         "crc32": crc,
     }
+    if head_ranges:
+        meta["head_ranges"] = [
+            [int(lo), int(hi)] for lo, hi in head_ranges
+        ]
     if leaf_meta is not None:
         meta["wire_codec"] = wire_codec
         meta["leaf_meta"] = leaf_meta
@@ -290,6 +346,36 @@ def unpack_handoff(msg: Message) -> KVHandoff:
         else:
             arrs = codec.unpack_many(msg.payload, meta["frame_lens"])
         per_block = 4 if quantized else 2
+        ranges = meta.get("head_ranges")
+        if ranges:
+            # Sender-side-resharded wire: each KV tensor arrived as
+            # one slice per destination head tile. Reassemble the full
+            # head range on the HOST (np.concatenate along the head
+            # axis — the fetch_head_shards discipline: host concat,
+            # never a device-side gather); adoption re-slices per the
+            # local pool's own plan, so a tp-matched receiver pays one
+            # view, not a reorder.
+            r = len(ranges)
+            if len(arrs) != 1 + n_blocks * per_block * r:
+                raise ValueError(
+                    f"{len(arrs)} tensors for {n_blocks} blocks x "
+                    f"{r} head tiles (quantized={quantized})"
+                )
+            widths = [int(hi) - int(lo) for lo, hi in ranges]
+            joined = [arrs[0]]
+            for i in range(n_blocks * per_block):
+                pieces = arrs[1 + i * r : 1 + (i + 1) * r]
+                for p, w in zip(pieces, widths):
+                    if p.ndim < 2 or p.shape[1] != w:
+                        raise ValueError(
+                            f"head tile shape {p.shape} != declared "
+                            f"width {w}"
+                        )
+                joined.append(
+                    pieces[0] if r == 1
+                    else np.concatenate(pieces, axis=1)
+                )
+            arrs = joined
         if len(arrs) != 1 + n_blocks * per_block:
             raise ValueError(
                 f"{len(arrs)} tensors for {n_blocks} blocks "

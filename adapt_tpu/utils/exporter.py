@@ -340,6 +340,7 @@ def serve_metrics(
     worker: str | None = None,
     journal=None,
     capacity_provider=None,
+    placements_provider=None,
 ) -> ThreadingHTTPServer:
     """Start the exporter on a daemon thread; returns the server
     (``.server_address[1]`` is the bound port). Stop with
@@ -355,7 +356,11 @@ def serve_metrics(
     ``/debug/request/<id>`` with submit metadata. ``capacity_provider``
     (zero-arg -> capacity book dict, e.g. a batcher's
     ``capacity_book``) makes this process a ``/fleet/capacity`` source
-    and stamps the book onto ``/telemetry.json`` pulls."""
+    and stamps the book onto ``/telemetry.json`` pulls.
+    ``placements_provider`` (zero-arg -> dict, e.g. a
+    ``runtime/router.FleetRouter``'s ``placements`` method) turns on
+    ``GET /fleet/placements`` — the router's bounded decision ring:
+    why each recent request landed on the replica it did."""
     reg = registry if registry is not None else global_metrics()
     tr = tracer if tracer is not None else global_tracer()
     rec = recorder if recorder is not None else global_flight_recorder()
@@ -453,6 +458,18 @@ def serve_metrics(
                 # role/worker/pid with first-class age_s staleness —
                 # the router/autoscaler placement view.
                 body = _json_bytes(fed.capacity_snapshot())
+                ctype = "application/json"
+            elif path == "/fleet/placements":
+                # The router's decision ring: which replica each
+                # recent request landed on and WHY (affinity tokens,
+                # forecast, queue, health, the losing alternatives).
+                # 404 when no router runs in this process — a fleet
+                # endpoint must not fabricate an empty router.
+                if placements_provider is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = _json_bytes(placements_provider())
                 ctype = "application/json"
             elif path == "/telemetry.json":
                 body = _json_bytes(pull_reporter.collect())

@@ -389,8 +389,18 @@ class FederatedStore:
         #: ``worker_id -> (book, first-seen-mono-at-this-wall)``. The
         #: mono stamp only advances when the book's ``wall`` does, and
         #: entries OUTLIVE their lease — an expired or frozen source
-        #: reads as growing age, never as a fresh book.
+        #: reads as growing age, never as a fresh book — up to
+        #: ``capacity_max_age_s``, where they evict for good.
         self._lease_caps: dict[str, tuple[dict, float]] = {}
+        #: Staleness evict for :meth:`capacity_snapshot`: a book older
+        #: than this (lease-sourced or telemetry-sourced) leaves the
+        #: placement view entirely. The GROWING-age window below the
+        #: bound is the operator's "it's wedged" signal; past it, a
+        #: replica dead for minutes must stop being a placement
+        #: candidate — the capacity plane owns staleness policy so no
+        #: router has to re-implement it. None = keep forever (the
+        #: pre-evict behavior).
+        self.capacity_max_age_s: float | None = 60.0
         self._journal = None
         self.poll_interval_s = 1.0
         self.poll_timeout_s = 1.0
@@ -679,7 +689,9 @@ class FederatedStore:
         ``age_s`` staleness. A killed source's last book stays in the
         view with GROWING age (placement must see "stale", not
         "gone"); a router treats age above its own bound as no
-        capacity at all."""
+        capacity at all, and past ``capacity_max_age_s`` the book
+        EVICTS — a replica dead for minutes is not a placement
+        candidate and must not scroll a fleet view forever."""
         if refresh:
             self.refresh()
         now = time.monotonic()
@@ -697,6 +709,7 @@ class FederatedStore:
             except Exception:  # noqa: BLE001 — a wedged registry must
                 log.exception("capacity lease scan failed")
         replicas: dict[str, dict] = {}
+        max_age = self.capacity_max_age_s
         with self._lock:
             for wid, book in lease_books.items():
                 prev = self._lease_caps.get(wid)
@@ -704,8 +717,23 @@ class FederatedStore:
                     "wall"
                 ):
                     self._lease_caps[wid] = (book, now)
+            if max_age is not None:
+                # The evict: books stale past the bound leave the view
+                # (lease-sourced entries drop from the retention map
+                # itself; telemetry-sourced ones just stop listing —
+                # their _Source may still carry live counters).
+                for wid in [
+                    w
+                    for w, (_, mono) in self._lease_caps.items()
+                    if now - mono > max_age
+                ]:
+                    del self._lease_caps[wid]
             for key, s in self._sources.items():
                 if s.capacity is None:
+                    continue
+                if max_age is not None and (
+                    now - s.capacity_mono > max_age
+                ):
                     continue
                 replicas[key] = {
                     "role": s.role,

@@ -1,0 +1,197 @@
+"""Fleet-router smoke: prefix-affinity placement vs random, A/B.
+
+Two gated records for ``runtime/router.FleetRouter`` (the DECISION
+half of the capacity plane — docs/SERVING.md "Fleet routing"):
+
+- ``load_router_affinity_ttft_ratio`` — the SAME seeded tenant-skewed
+  corpus schedule (recurring Zipf-weighted prefixes) runs twice over
+  a 2-replica fleet: once placed by the affinity scorer (capacity
+  books: prefix-affinity sketch folded into the TTFT forecast, queue
+  cost, health) and once by the random control arm. The record is
+  random TTFT p50 / affinity TTFT p50 — above 1.0 means affinity
+  placement turned resident prefixes into prefill skipped, i.e. the
+  scoring formula is WORTH its bookkeeping on the workload shape it
+  exists for.
+- ``load_router_prefix_hit_ratio`` — the structural half: fleet-summed
+  paged prefix-cache hits, affinity arm over random arm. Affinity
+  prefills each recurring prefix ONCE fleet-wide (every repeat lands
+  on the replica that already holds it); random splits a prefix's
+  occurrences across replicas and pays a second prefill per split.
+  This gate fails even when TTFT noise on a loaded CI box would mask
+  the win.
+
+Both arms drive the identical schedule through the identical fleet
+construction (same seeds, same engines, same warmup) — the placement
+policy is the ONLY difference.
+
+Usage: ``python benchmarks/load/router_smoke.py [--seed 0]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks.common import emit, int_flag  # noqa: E402
+from benchmarks.load.workload import build_schedule, preset  # noqa: E402
+
+#: 6 full pages per 96-token corpus prefix (capacity_smoke's choice).
+PAGE = 16
+#: Per-arm fleet width. Two replicas is the smallest fleet where
+#: placement matters: affinity concentrates each prefix on one of
+#: them, random splits it.
+REPLICAS = 2
+#: Per-replica HBM page pool, sized BELOW the full corpus working set
+#: (12 prefixes x 6 pages = 72 prefix pages + live decode pages) so
+#: residency stays a bounded resource. The measured effect is
+#: co-location: affinity prefills each recurring prefix once
+#: fleet-wide, random splits a prefix's occurrences across replicas
+#: and pays one extra prefill (one extra residency) per split.
+#: Under-capacity rate keeps TTFT a prefill measure, not a
+#: queue-cliff measure.
+POOL_PAGES = 64
+RATE_RPS = 10.0
+DURATION_S = 3.0
+
+_METRICS = (
+    ("load_router_affinity_ttft_ratio",
+     "random-placement TTFT p50 over affinity-placement TTFT p50 on "
+     "the same corpus schedule (>1 = affinity faster)"),
+    ("load_router_prefix_hit_ratio",
+     "fleet prefix-cache hits, affinity arm over random arm "
+     "(>1 = affinity keeps prefixes resident)"),
+)
+
+
+def _emit_errors(err: str) -> None:
+    for metric, unit in _METRICS:
+        print(
+            json.dumps(
+                {"metric": metric, "value": 0.0, "unit": unit,
+                 "vs_baseline": 0.0, "error": err}
+            ),
+            flush=True,
+        )
+
+
+def _run_arm(policy: str, seed: int, spec) -> dict:
+    """One arm: a fresh 2-replica fleet, warmed per-engine, driving
+    the seeded corpus schedule through the router TWICE and measuring
+    the SECOND pass (capacity_smoke's train-then-measure honesty: the
+    first pass pays every mid-phase compile variant — the prefix-hit
+    suffix passes warmup cannot know — and trains the forecasters, so
+    the measured pass is steady-state routing, not XLA). Returns the
+    measured phase report plus the fleet's prefix-hit count for it."""
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.load.harness import drive_phase, warmup
+
+    from adapt_tpu.config import CapacityConfig, RouterConfig
+    from adapt_tpu.models.transformer_lm import lm_tiny
+    from adapt_tpu.runtime.continuous import ContinuousBatcher
+    from adapt_tpu.runtime.router import FleetRouter
+
+    lm = lm_tiny(
+        vocab=spec.vocab,
+        max_len=spec.prompt_max + spec.steps_max + 8,
+    )
+    variables = lm.graph.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )
+    engines = {
+        f"r{i}": ContinuousBatcher(
+            lm, variables, slots=2, chunk=4, kv_layout="paged",
+            page_size=PAGE, pool_pages=POOL_PAGES,
+            # Books refresh every tick (placement must read the
+            # CURRENT sketch/queue, not a quarter-second-old one) and
+            # the sketch is sized to cover a full pool — a sketch
+            # smaller than residency under-reports affinity.
+            capacity=CapacityConfig(refresh_s=0.0, sketch_k=POOL_PAGES),
+        )
+        for i in range(REPLICAS)
+    }
+    # Warm each ENGINE directly (not through the router): both arms
+    # must pay identical compile cost on every replica, or the first
+    # placements would measure XLA, not routing.
+    for eng in engines.values():
+        warmup(eng, spec.vocab, spec.steps_max, spec.prompt_max)
+    router = FleetRouter(
+        engines, config=RouterConfig(policy=policy), seed=seed
+    )
+    # Train pass: identical schedule, identical seed — every compile
+    # variant (including the prefix-hit suffix passes warmup cannot
+    # know) and the TTFT forecasters reach steady state.
+    drive_phase(router, build_schedule(spec, seed), spec)
+    # Cold corpus, warm XLA: drop all cached prefix pages so the
+    # measured pass pays REAL prefill per miss, never a compile.
+    # This is the regime the A/B exists for — affinity prefills each
+    # prefix once fleet-wide and then hits; random re-prefills it on
+    # every replica it sprays the prefix onto.
+    for eng in engines.values():
+        eng._pager.evict_cached()
+    hits0 = router.stats().get("prefix_hits", 0)
+    report = drive_phase(router, build_schedule(spec, seed), spec)
+    report["prefix_hits"] = router.stats().get("prefix_hits", 0) - hits0
+    report["policy"] = policy
+    report["router"] = {
+        k: router.stats()[k]
+        for k in ("placed", "shed", "replaced", "replicas_live")
+    }
+    router.close(close_engines=True)
+    return report
+
+
+def main() -> int:
+    seed = int_flag(sys.argv, "--seed", 0)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        spec = preset(
+            "corpus", duration_s=DURATION_S, rate_rps=RATE_RPS
+        )
+        affinity = _run_arm("affinity", seed, spec)
+        random_ = _run_arm("random", seed, spec)
+
+        aff_p50 = affinity["ttft_s"].get("p50", 0.0)
+        rnd_p50 = random_["ttft_s"].get("p50", 0.0)
+        ttft_ratio = (rnd_p50 / aff_p50) if aff_p50 > 0 else 0.0
+        emit(
+            _METRICS[0][0],
+            round(ttft_ratio, 4),
+            _METRICS[0][1],
+            round(ttft_ratio - 1.0, 4),
+            seed=seed,
+            affinity_ttft_s=affinity["ttft_s"],
+            random_ttft_s=random_["ttft_s"],
+            affinity_goodput_tokens_s=affinity["goodput_tokens_s"],
+            random_goodput_tokens_s=random_["goodput_tokens_s"],
+            requests=affinity["requests"],
+            router_affinity=affinity["router"],
+            router_random=random_["router"],
+        )
+
+        hit_ratio = (
+            affinity["prefix_hits"] / random_["prefix_hits"]
+            if random_["prefix_hits"]
+            else (float(affinity["prefix_hits"]) or 0.0)
+        )
+        emit(
+            _METRICS[1][0],
+            round(hit_ratio, 4),
+            _METRICS[1][1],
+            round(hit_ratio - 1.0, 4),
+            seed=seed,
+            affinity_prefix_hits=affinity["prefix_hits"],
+            random_prefix_hits=random_["prefix_hits"],
+        )
+    except Exception as e:  # noqa: BLE001 — always JSON lines, rc 0
+        _emit_errors(str(e)[-300:])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,18 +3,27 @@
 
 Read-only: polls ``GET /fleet/capacity`` (the capacity books every
 replica publishes — health, headroom, TTFT forecast, affinity-sketch
-size) and ``GET /fleet/metrics.json`` (per-source goodput gauges) from
-one ``serve_metrics`` exporter and renders the router's-eye view:
+size), ``GET /fleet/metrics.json`` (per-source goodput gauges) and,
+when the process runs a ``runtime/router.FleetRouter``,
+``GET /fleet/placements`` (the router's decision ring) from one
+``serve_metrics`` exporter and renders the router's-eye view:
 
-    KEY                ROLE    VIA    AGE   HEALTH    SLOTS  PAGES  QUEUE  TTFT-FC  CAL   GOODPUT
-    decode:w0:4242     decode  telem  0.2s  ok         3/8    118   0.12   0.012s   0.94  1832.4
+    KEY                ROLE    VIA    AGE   HEALTH    SLOTS  PAGES  QUEUE  TTFT-FC  CAL   GOODPUT  SKETCH  ROUTE
+    decode:w0:4242     decode  telem  0.2s  ok         3/8    118   0.12   0.012s   0.94  1832.4   12      9x aff:96
+
+The ROUTE column is why the router last picked the replica (placement
+count, last decision's affinity-hit tokens / forecast) — "-" when no
+router publishes placements. ``--sort`` reorders by what an operator
+is hunting: ``health`` (worst first), ``forecast`` (slowest TTFT
+estimate first), ``affinity`` (hottest sketch first).
 
 No dependencies beyond the standard library (urllib), no mutation —
 safe to point at a live deployment.
 
 Usage::
 
-    python scripts/fleet_top.py --url http://127.0.0.1:9100 [--interval 2.0] [--once]
+    python scripts/fleet_top.py --url http://127.0.0.1:9100 \
+        [--interval 2.0] [--once] [--sort health|forecast|affinity]
 """
 
 from __future__ import annotations
@@ -54,7 +63,40 @@ def _fmt_headroom(hr: dict) -> tuple[str, str, str]:
     return slots, pages, queue
 
 
-def _rows(caps: dict, fleet: dict) -> list[tuple]:
+def _route_col(key: str, placements: dict) -> tuple[str, int]:
+    """The router-decision column for one capacity key: how many of
+    the ring's placements landed on this replica and the last
+    decision's why. Router decisions name replicas by their short
+    name; capacity keys carry it as a ``decode:<name>`` segment (lease
+    keys verbatim, telemetry keys role:worker:pid)."""
+    decisions = placements.get("decisions") or ()
+    count, last = 0, None
+    for d in decisions:
+        name = d.get("replica")
+        if not name:
+            continue
+        if f"decode:{name}" in key or key.endswith(f":{name}"):
+            count += 1
+            last = d
+    if last is None:
+        return "-", 0
+    why = last.get("why") or {}
+    aff = int(why.get("affinity_tokens", 0))
+    if aff > 0:
+        return f"{count}x aff:{aff}", count
+    fc = float(why.get("forecast_s", 0.0) or 0.0)
+    if fc > 0:
+        return f"{count}x fc:{fc:.3f}", count
+    return f"{count}x load", count
+
+
+#: ok sorts after degraded/critical when hunting trouble.
+_HEALTH_RANK = {"critical": 0, "degraded": 1, "unknown": 2, "ok": 3}
+
+
+def _rows(
+    caps: dict, fleet: dict, placements: dict, sort: str = "key"
+) -> list[tuple]:
     goodput = {
         key: src.get("gauges", {}).get("continuous.goodput_tokens_s")
         for key, src in fleet.get("sources", {}).items()
@@ -74,12 +116,26 @@ def _rows(caps: dict, fleet: dict) -> list[tuple]:
             fc.get("queue_wait_s", 0.0) + wall + fc.get("tick_gap_s", 0.0)
         )
         gp = goodput.get(key)
-        rows.append((
+        health = str(book.get("health", "?"))
+        sketch_n = len(book.get("sketch", {}).get("entries", ()))
+        route, _ = _route_col(key, placements)
+        sort_key = {
+            "key": key,
+            # Worst health first, staleness breaking ties (an aged
+            # "ok" book deserves a look before a fresh one).
+            "health": (
+                _HEALTH_RANK.get(health, 2),
+                -float(rep.get("age_s", 0.0)),
+            ),
+            "forecast": -est,  # slowest replica first
+            "affinity": -sketch_n,  # hottest sketch first
+        }[sort]
+        rows.append((sort_key, (
             key[:24],
             str(rep.get("role", "?"))[:8],
             {"telemetry": "telem"}.get(rep.get("via"), rep.get("via")),
             f"{rep.get('age_s', 0.0):.1f}s",
-            str(book.get("health", "?")),
+            health,
             slots,
             pages,
             queue,
@@ -89,16 +145,18 @@ def _rows(caps: dict, fleet: dict) -> list[tuple]:
                 if fc.get("samples") else "-"
             ),
             f"{gp:.1f}" if gp is not None else "-",
-            str(len(book.get("sketch", {}).get("entries", ()))),
-        ))
-    return rows
+            str(sketch_n),
+            route,
+        )))
+    rows.sort(key=lambda t: t[0])
+    return [r for _, r in rows]
 
 
 _HDR = (
     "KEY", "ROLE", "VIA", "AGE", "HEALTH", "SLOTS", "PAGES",
-    "QUEUE", "TTFT-FC", "CAL", "GOODPUT", "SKETCH",
+    "QUEUE", "TTFT-FC", "CAL", "GOODPUT", "SKETCH", "ROUTE",
 )
-_W = (24, 8, 6, 7, 9, 7, 6, 6, 8, 5, 9, 6)
+_W = (24, 8, 6, 7, 9, 7, 6, 6, 8, 5, 9, 6, 12)
 
 
 def _render(rows: list[tuple]) -> str:
@@ -126,6 +184,13 @@ def main(argv: list[str] | None = None) -> int:
         "--once", action="store_true",
         help="print one snapshot and exit (no screen clearing)",
     )
+    ap.add_argument(
+        "--sort", default="key",
+        choices=("key", "health", "forecast", "affinity"),
+        help="row order: lexical key (default), worst health first, "
+        "slowest TTFT forecast first, or hottest affinity sketch "
+        "first",
+    )
     args = ap.parse_args(argv)
     base = args.url.rstrip("/")
     while True:
@@ -138,7 +203,11 @@ def main(argv: list[str] | None = None) -> int:
                 return 1
             time.sleep(args.interval)
             continue
-        out = _render(_rows(caps, fleet))
+        try:
+            placements = _fetch(base + "/fleet/placements")
+        except (urllib.error.URLError, OSError, ValueError):
+            placements = {}  # no router in this process: 404 is fine
+        out = _render(_rows(caps, fleet, placements, args.sort))
         if args.once:
             print(out)
             return 0
